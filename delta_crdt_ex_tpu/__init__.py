@@ -1,0 +1,57 @@
+"""delta_crdt_ex_tpu — a TPU-native delta-CRDT framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the Elixir library
+``delta_crdt`` v0.5.10 (reference: /root/reference — Almeida et al. 2016
+anti-entropy Algorithm 2 + Enes et al. 2018 join decomposition, see
+reference ``lib/delta_crdt.ex:9``).
+
+Architecture (TPU-first, NOT a translation of the actor design):
+
+- **Lattice** (:mod:`delta_crdt_ex_tpu.models.aw_lww_map`): the replica's
+  dot store lives in HBM as a struct-of-arrays tensor state; join / LWW
+  read / batched mutation are fused XLA kernels.
+- **Sync index** (:mod:`delta_crdt_ex_tpu.ops.hashtree`): the merkle tree
+  becomes a device-resident digest tree with commutative per-bucket
+  digests; the reference's continuation ping-pong becomes a
+  bounded-frontier level walk (static shapes, cost ∝ divergence).
+- **Runtime** (:mod:`delta_crdt_ex_tpu.runtime`): host-side replica
+  drivers issue compiled kernel calls; anti-entropy scheduling, ack
+  bookkeeping, failure detection, storage and telemetry mirror the
+  reference's capability surface (``causal_crdt.ex``).
+- **Parallel** (:mod:`delta_crdt_ex_tpu.parallel`): neighbour fan-out is
+  a vmapped batch axis (one device call syncs all neighbours); multi-chip
+  replication rides ``shard_map`` + collectives over a ``jax.sharding.Mesh``.
+
+64-bit integers (key hashes, dot ids, timestamps) are first-class in this
+framework, so x64 is enabled at import. All arrays use explicit dtypes;
+nothing relies on default-dtype promotion.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from delta_crdt_ex_tpu.api import (  # noqa: E402
+    DeltaCrdt,
+    mutate,
+    mutate_async,
+    read,
+    set_neighbours,
+    start_link,
+)
+from delta_crdt_ex_tpu.models.aw_lww_map import AWLWWMap  # noqa: E402
+from delta_crdt_ex_tpu.runtime.storage import MemoryStorage, Storage  # noqa: E402
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AWLWWMap",
+    "DeltaCrdt",
+    "MemoryStorage",
+    "Storage",
+    "mutate",
+    "mutate_async",
+    "read",
+    "set_neighbours",
+    "start_link",
+]

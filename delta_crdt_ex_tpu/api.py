@@ -1,0 +1,94 @@
+"""Public API facade — parity with ``DeltaCrdt`` (``lib/delta_crdt.ex``).
+
+The reference surface maps 1:1 (``delta_crdt.ex:56,97,117,126,135``):
+
+==========================  ==========================================
+reference                   here
+==========================  ==========================================
+``start_link(module, o)``   :func:`start_link` (spawns the sync thread)
+``set_neighbours/2``        :func:`set_neighbours` (one-way edges)
+``mutate/4``                :func:`mutate`
+``mutate_async/3``          :func:`mutate_async`
+``read/2``                  :func:`read`
+``child_spec/1``            :func:`child_spec` (supervision metadata)
+==========================  ==========================================
+
+Defaults match the reference: ``sync_interval`` 200 ms, ``max_sync_size``
+200 (``delta_crdt.ex:31-32``).
+
+TPU-native extensions live on :class:`~delta_crdt_ex_tpu.runtime.replica.
+Replica` itself (deterministic ``sync_to_all``/``process_pending`` for
+test quiescence, ``read_keys``, ``checkpoint``, ``gc``) and in
+:mod:`delta_crdt_ex_tpu.parallel` (batched neighbour sync, mesh gossip).
+
+Example (the reference doctest flow, ``delta_crdt.ex:17-28``)::
+
+    crdt1 = start_link(AWLWWMap, sync_interval=0.003)
+    crdt2 = start_link(AWLWWMap, sync_interval=0.003)
+    set_neighbours(crdt1, [crdt2])
+    set_neighbours(crdt2, [crdt1])
+    mutate(crdt1, "add", ["CRDT", "is magic!"])
+    ...
+    read(crdt2)  # {'CRDT': 'is magic!'}
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from delta_crdt_ex_tpu.models.aw_lww_map import AWLWWMap
+from delta_crdt_ex_tpu.runtime.replica import Replica
+
+DEFAULT_SYNC_INTERVAL = 0.2  # seconds (reference: 200 ms, delta_crdt.ex:31)
+DEFAULT_MAX_SYNC_SIZE = 200  # items (reference: delta_crdt.ex:32)
+
+DeltaCrdt = Replica  # the handle type users hold
+
+
+def start_link(crdt_module=AWLWWMap, *, threaded: bool = True, **opts) -> Replica:
+    """Start a replica (reference ``DeltaCrdt.start_link/2``).
+
+    ``threaded=True`` runs the periodic anti-entropy loop in a background
+    thread (the GenServer-process analog). ``threaded=False`` leaves
+    driving to the caller (deterministic tests / benches call
+    ``sync_to_all()`` + ``transport.pump()``).
+    """
+    opts.setdefault("sync_interval", DEFAULT_SYNC_INTERVAL)
+    opts.setdefault("max_sync_size", DEFAULT_MAX_SYNC_SIZE)
+    replica = Replica(crdt_module, **opts)
+    if threaded:
+        replica.start()
+    return replica
+
+
+def child_spec(opts: dict | None = None) -> dict:
+    """Supervision metadata (reference ``child_spec/1``, ``delta_crdt.ex:68-82``)."""
+    opts = dict(opts or {})
+    crdt = opts.pop("crdt", None)
+    if crdt is None:
+        raise ValueError(f"must specify 'crdt' in options, got: {opts!r}")
+    name = opts.get("name", "DeltaCrdt")
+    shutdown = opts.pop("shutdown", 5.0)
+    return {
+        "id": name,
+        "start": (start_link, (crdt,), opts),
+        "shutdown": shutdown,
+    }
+
+
+def set_neighbours(crdt: Replica, neighbours: list) -> None:
+    """One-way sync edges; call symmetrically for bidirectional sync
+    (reference note, ``delta_crdt.ex:84-95``)."""
+    crdt.set_neighbours(neighbours)
+
+
+def mutate(crdt: Replica, f: str, args: list, timeout: float = 5.0) -> None:
+    crdt.mutate(f, args, timeout)
+
+
+def mutate_async(crdt: Replica, f: str, args: list) -> None:
+    crdt.mutate_async(f, args)
+
+
+def read(crdt: Replica, timeout: float = 5.0) -> dict[Any, Any]:
+    return crdt.read(timeout)

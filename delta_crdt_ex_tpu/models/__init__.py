@@ -1,0 +1,4 @@
+from delta_crdt_ex_tpu.models.aw_lww_map import AWLWWMap
+from delta_crdt_ex_tpu.models.state import DotStore
+
+__all__ = ["AWLWWMap", "DotStore"]

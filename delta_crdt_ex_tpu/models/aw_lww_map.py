@@ -1,0 +1,60 @@
+"""AWLWWMap — the Add-Wins Last-Write-Wins observed-remove map model.
+
+This is the TPU-native counterpart of the reference's pluggable
+``crdt_module`` (``DeltaCrdt.AWLWWMap``, ``aw_lww_map.ex``): it bundles the
+empty state constructor, the mutation-op vocabulary, and jit-compiled
+entry points for the lattice kernels. The replica runtime
+(:mod:`delta_crdt_ex_tpu.runtime.replica`) is generic over this model
+class, mirroring the reference's ``crdt_module`` indirection
+(``causal_crdt.ex:50,72,189,339,384``) — an alternative CRDT model only
+needs to provide the same surface.
+
+Semantic contract carried over (SURVEY §7 non-negotiables):
+
+- add-wins / observed-remove: a remove kills only observed dots
+  (``aw_lww_map.ex:133-146``); concurrent adds survive;
+- LWW among surviving values by timestamp (``:211-216``), ties broken
+  deterministically by (ts, writer gid, counter);
+- causal join per key ``(s1∩s2) ∪ (s1∖c2) ∪ (s2∖c1)`` (``:196-209``);
+- context union = per-replica max (``:45-52``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+from delta_crdt_ex_tpu.models.state import DotStore
+from delta_crdt_ex_tpu.ops import apply as apply_ops
+from delta_crdt_ex_tpu.ops import hashtree, join as join_ops, read as read_ops
+
+# jit-compiled kernel entry points (shared compilation caches).
+jit_join = jax.jit(join_ops.join)
+jit_extract = jax.jit(join_ops.extract_buckets, static_argnames=("out_size",))
+jit_apply = jax.jit(apply_ops.apply_batch)
+jit_digest_tree = jax.jit(hashtree.digest_tree, static_argnames=("depth",))
+jit_winners_for_keys = jax.jit(read_ops.winners_for_keys)
+jit_winner_mask = jax.jit(read_ops.winner_mask)
+jit_winner_slice = jax.jit(read_ops.winner_slice, static_argnames=("out_size",))
+
+
+class AWLWWMap:
+    """Model class: op vocabulary + kernels over :class:`DotStore`."""
+
+    #: mutation name → (op code, arity of user args)
+    OPS = {
+        "add": (apply_ops.OP_ADD, 2),  # add(key, value)    aw_lww_map.ex:99
+        "remove": (apply_ops.OP_REMOVE, 1),  # remove(key)  aw_lww_map.ex:133
+        "clear": (apply_ops.OP_CLEAR, 0),  # clear()        aw_lww_map.ex:148
+    }
+
+    new = staticmethod(DotStore.new)
+    join = staticmethod(jit_join)
+    extract_buckets = staticmethod(jit_extract)
+    slice_to_store = staticmethod(join_ops.slice_to_store)
+    apply_batch = staticmethod(jit_apply)
+    digest_tree = staticmethod(jit_digest_tree)
+    winners_for_keys = staticmethod(jit_winners_for_keys)
+    winner_mask = staticmethod(jit_winner_mask)
+    winner_slice = staticmethod(jit_winner_slice)

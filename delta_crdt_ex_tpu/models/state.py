@@ -1,0 +1,136 @@
+"""Device-resident dot-store state — the tensorised AWLWWMap lattice.
+
+The reference stores a replica as a 2-level nested map
+``%{key => %{{value, ts} => MapSet(dots)}}`` plus a causal context
+(``aw_lww_map.ex:2-3``). Every add creates exactly one dot attached to one
+``{value, ts}`` pair (``aw_lww_map.ex:119-122``), so the whole state
+flattens losslessly into a struct-of-arrays of *entries*, one per live dot:
+
+    key   : uint64[C]  64-bit key hash (host keeps hash → term dict)
+    valh  : uint32[C]  value content digest (for the sync index)
+    ts    : int64[C]   LWW timestamp, nanoseconds
+    node  : int32[C]   writer replica as LOCAL slot index into ctx tables
+    ctr   : uint32[C]  dot counter (dot = (gid_of(node), ctr), globally unique)
+    alive : bool[C]    slot occupancy mask (dead slots are reused)
+
+The causal context is kept in compressed state form — per-replica max
+counter, exactly the reference's ``Dots.compress`` representation
+(``aw_lww_map.ex:13-20``) — but **decomposed per leaf bucket of the sync
+index**:
+
+    ctx_gid : uint64[R]     slot → global 64-bit replica id (0 = empty)
+    ctx_max : uint32[L, R]  per-bucket per-replica max observed counter
+
+Bucket decomposition is a deliberate strengthening over the reference:
+the reference ships its *global* context alongside *partial* (truncated)
+key slices (``causal_crdt.ex:105,259``), which lets a receiver's context
+leap ahead of the keys it actually incorporated — after which the skipped
+keys' entries test as "already seen" and can never be delivered (a latent
+unsoundness its test suite never exercises, since no test syncs more than
+``max_sync_size`` divergent keys). Here the sync atom is a leaf bucket:
+a slice carries all entries **and the context rows** of exactly the
+synced buckets, so coverage can never outrun content. Within a bucket
+the compression semantics (per-node max ⇒ covered) are identical to the
+reference's.
+
+Slot indices are replica-LOCAL; when two states meet their gid tables are
+merged on device and incoming ``node`` columns are remapped
+(:func:`delta_crdt_ex_tpu.ops.dots.merge_contexts`). Values never live on
+device — the host keeps a ``dot → (key_term, value)`` payload store.
+
+Capacity C and replica capacity R are static (power-of-two tiers) so every
+kernel compiles once per tier; growth pads with dead slots (no data moves).
+L is fixed per cluster (all replicas must agree on the sync-index depth).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["key", "valh", "ts", "node", "ctr", "alive", "ctx_gid", "ctx_max"],
+    meta_fields=[],
+)
+@dataclasses.dataclass(frozen=True)
+class DotStore:
+    key: jax.Array  # uint64[C]
+    valh: jax.Array  # uint32[C]
+    ts: jax.Array  # int64[C]
+    node: jax.Array  # int32[C]
+    ctr: jax.Array  # uint32[C]
+    alive: jax.Array  # bool[C]
+    ctx_gid: jax.Array  # uint64[R]
+    ctx_max: jax.Array  # uint32[L, R]
+
+    @property
+    def capacity(self) -> int:
+        return self.key.shape[-1]
+
+    @property
+    def replica_capacity(self) -> int:
+        return self.ctx_gid.shape[-1]
+
+    @property
+    def num_buckets(self) -> int:
+        return self.ctx_max.shape[-2]
+
+    @staticmethod
+    def new(
+        capacity: int = 1024, replica_capacity: int = 64, num_buckets: int = 4096
+    ) -> "DotStore":
+        """Empty lattice state (reference ``AWLWWMap.new/0`` + ``compress_dots``,
+        ``causal_crdt.ex:72``: the context starts in compressed state form)."""
+        return DotStore(
+            key=jnp.zeros(capacity, jnp.uint64),
+            valh=jnp.zeros(capacity, jnp.uint32),
+            ts=jnp.zeros(capacity, jnp.int64),
+            node=jnp.zeros(capacity, jnp.int32),
+            ctr=jnp.zeros(capacity, jnp.uint32),
+            alive=jnp.zeros(capacity, bool),
+            ctx_gid=jnp.zeros(replica_capacity, jnp.uint64),
+            ctx_max=jnp.zeros((num_buckets, replica_capacity), jnp.uint32),
+        )
+
+    def grow(self, capacity: int | None = None, replica_capacity: int | None = None) -> "DotStore":
+        """Pad to a larger tier (recompile-free w.r.t. data: dead slots only)."""
+        c_new = capacity or self.capacity
+        r_new = replica_capacity or self.replica_capacity
+        dc = c_new - self.capacity
+        dr = r_new - self.replica_capacity
+        assert dc >= 0 and dr >= 0
+        pad = lambda a, d: jnp.pad(a, (0, d)) if d else a
+        return DotStore(
+            key=pad(self.key, dc),
+            valh=pad(self.valh, dc),
+            ts=pad(self.ts, dc),
+            node=pad(self.node, dc),
+            ctr=pad(self.ctr, dc),
+            alive=pad(self.alive, dc),
+            ctx_gid=pad(self.ctx_gid, dr),
+            ctx_max=jnp.pad(self.ctx_max, ((0, 0), (0, dr))) if dr else self.ctx_max,
+        )
+
+    def entry_gid(self) -> jax.Array:
+        """uint64[C]: global replica id of each entry's writer (dot identity)."""
+        return self.ctx_gid[self.node]
+
+    def global_ctx(self) -> jax.Array:
+        """uint32[R]: the reference's global compressed context view
+        (per-replica max over all buckets)."""
+        return jnp.max(self.ctx_max, axis=0)
+
+    def own_counter(self, slot) -> jax.Array:
+        """uint32: highest dot counter this replica has issued."""
+        return jnp.max(self.ctx_max[:, slot])
+
+    def num_alive(self) -> jax.Array:
+        return jnp.sum(self.alive.astype(jnp.int32))
+
+    def free_slots(self) -> jax.Array:
+        return jnp.sum((~self.alive).astype(jnp.int32))

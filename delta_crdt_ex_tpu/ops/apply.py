@@ -1,0 +1,112 @@
+"""Local mutation batch kernel.
+
+The reference applies one operation per GenServer message: ``add`` =
+remove-delta ⊔ fresh-dot add-delta (``aw_lww_map.ex:99-112``), ``remove``
+= delta whose context is the key's observed dots (``:133-146``), then an
+immediate join into the state (``causal_crdt.ex:337-342``). One device
+call per mutation would waste the chip, so the TPU-native driver batches
+queued mutations and this kernel applies a whole batch with
+**sequential semantics** in one fused pass:
+
+- each add is assigned the next dot counter in batch order
+  (``Dots.next_dot``, ``aw_lww_map.ex:30-37`` — here a cumsum);
+- a batch entry survives iff no later batch op touches its key (and no
+  later ``clear``);
+- pre-batch entries die iff any batch op touches their key (every local
+  op observes the whole pre-batch state — the replica sees all its dots)
+  or any ``clear`` is present.
+
+``clear`` is implemented properly here; in the reference it exists but is
+unreachable through ``mutate`` (``causal_crdt.ex:337`` can't match zero-arg
+ops — documented quirk, not replicated).
+
+Op codes: 0 = padding, 1 = add, 2 = remove, 3 = clear.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from delta_crdt_ex_tpu.models.state import DotStore
+
+OP_PAD = 0
+OP_ADD = 1
+OP_REMOVE = 2
+OP_CLEAR = 3
+
+_SENTINEL = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+class ApplyResult(NamedTuple):
+    state: DotStore
+    ok: jnp.ndarray  # bool
+    ctr_assigned: jnp.ndarray  # uint32[K]: dot counter per add op (host payload keying)
+
+
+def apply_batch(
+    state: DotStore,
+    self_slot: jnp.ndarray,  # int32 scalar: local ctx slot of this replica
+    op: jnp.ndarray,  # int32[K]
+    key: jnp.ndarray,  # uint64[K]
+    valh: jnp.ndarray,  # uint32[K]
+    ts: jnp.ndarray,  # int64[K]
+) -> ApplyResult:
+    c = state.capacity
+    k = op.shape[0]
+
+    is_add = op == OP_ADD
+    is_touch = is_add | (op == OP_REMOVE)
+    is_clear = op == OP_CLEAR
+
+    # Fresh dots for adds, sequential within the batch (``Dots.next_dot``:
+    # one counter per replica across all buckets).
+    base = state.own_counter(self_slot)
+    add_seq = jnp.cumsum(is_add.astype(jnp.uint32))
+    ctr_assigned = base + add_seq
+    # context rows: every created dot is observed in its key's bucket row
+    num_buckets = state.num_buckets
+    add_bucket = jnp.where(
+        is_add, (key & jnp.uint64(num_buckets - 1)).astype(jnp.int32), num_buckets
+    )
+    ctx_max = state.ctx_max.at[add_bucket, self_slot].max(ctr_assigned, mode="drop")
+
+    # Batch-internal shadowing: op i dies if a later op touches the same key.
+    later = jnp.triu(jnp.ones((k, k), bool), 1)  # j > i
+    key_eq = key[None, :] == key[:, None]
+    shadowed = jnp.any(later & ((key_eq & is_touch[None, :]) | is_clear[None, :]), axis=1)
+    ins_alive = is_add & ~shadowed
+
+    # Pre-batch entries: die if any batch op touches their key, or any clear.
+    touched_keys = jnp.where(is_touch, key, _SENTINEL)
+    s = jnp.sort(touched_keys)
+    pos = jnp.searchsorted(s, state.key)
+    touched = (s[jnp.clip(pos, 0, k - 1)] == state.key) & (pos < k) & (state.key != _SENTINEL)
+    any_clear = jnp.any(is_clear)
+    alive1 = state.alive & ~touched & ~any_clear
+
+    # Insert surviving adds into free slots.
+    free = ~alive1
+    free_rank = jnp.cumsum(free.astype(jnp.int32)) - 1
+    slot_of_rank = (
+        jnp.full(c, c, jnp.int32)
+        .at[jnp.where(free, free_rank, c)]
+        .set(jnp.arange(c, dtype=jnp.int32), mode="drop")
+    )
+    ins_rank = jnp.cumsum(ins_alive.astype(jnp.int32)) - 1
+    n_ins = jnp.sum(ins_alive.astype(jnp.int32))
+    ok = n_ins <= jnp.sum(free.astype(jnp.int32))
+    tgt = jnp.where(ins_alive, slot_of_rank[jnp.clip(ins_rank, 0, c - 1)], c)
+
+    new_state = DotStore(
+        key=state.key.at[tgt].set(key, mode="drop"),
+        valh=state.valh.at[tgt].set(valh, mode="drop"),
+        ts=state.ts.at[tgt].set(ts, mode="drop"),
+        node=state.node.at[tgt].set(jnp.full(k, self_slot, jnp.int32), mode="drop"),
+        ctr=state.ctr.at[tgt].set(ctr_assigned, mode="drop"),
+        alive=alive1.at[tgt].set(True, mode="drop"),
+        ctx_gid=state.ctx_gid,
+        ctx_max=ctx_max,
+    )
+    return ApplyResult(new_state, ok, ctr_assigned)

@@ -1,0 +1,91 @@
+"""Causal-context kernels (the reference's ``AWLWWMap.Dots``, ``aw_lww_map.ex:10-97``).
+
+The context lives in compressed state form — per-replica max counter
+(``Dots.compress``, ``aw_lww_map.ex:13-20``) — decomposed per sync-index
+bucket: ``(ctx_gid u64[R], ctx_max u32[L, R])`` (see
+:mod:`delta_crdt_ex_tpu.models.state` for why bucket rows rather than one
+global row). Slot indices are replica-local, so whenever two states meet
+their gid tables must be merged and the incoming state's slots remapped.
+This happens **on device** (no host round-trip) so the same kernel serves
+host-driven sync and in-mesh ``shard_map`` gossip.
+
+Context lattice ops map to the reference like so:
+
+- union (per-replica max, ``Dots.union`` ``aw_lww_map.ex:45-52``) →
+  column-scatter max in :func:`merge_contexts` (bucket-rowwise);
+- membership ``{i,x} ∈ c ⟺ c[i] ≥ x`` (``Dots.member?`` ``:71-73``) →
+  a (bucket, slot) gather + compare (see callers in
+  :mod:`delta_crdt_ex_tpu.ops.join`);
+- ``next_dot`` (``:35-37``) → counter assignment in
+  :mod:`delta_crdt_ex_tpu.ops.apply` (cumsum over the mutation batch).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class MergedContext(NamedTuple):
+    ctx_gid: jnp.ndarray  # uint64[R]     merged slot table (local slots preserved)
+    ctx_max: jnp.ndarray  # uint32[L, R]  merged per-bucket context (the union)
+    remap: jnp.ndarray  # int32[Rr]     remote slot → local slot (-1 for empty)
+    remote_dense: jnp.ndarray  # uint32[L, R]  remote context in local slot indexing
+    overflow: jnp.ndarray  # bool          not enough free local slots for new gids
+
+
+def merge_contexts(
+    gid_l: jnp.ndarray,
+    max_l: jnp.ndarray,
+    gid_r: jnp.ndarray,
+    max_r: jnp.ndarray,
+) -> MergedContext:
+    """Merge a remote context into a local one.
+
+    Matching gids keep their local slot; unknown gids are assigned to free
+    local slots in remote-slot order. ``remote_dense`` re-expresses the
+    remote bucket rows over local slots so dot-membership tests against
+    the remote context become a single gather. Rows the remote side did
+    not ship (unsynced buckets: all-zero) union as no-ops.
+    """
+    r_local = gid_l.shape[0]
+
+    occupied_r = gid_r != 0
+    # R_l x R_r equality: which local slot holds each remote gid.
+    eq = (gid_l[:, None] == gid_r[None, :]) & occupied_r[None, :]
+    has_match = jnp.any(eq, axis=0)
+    match_idx = jnp.argmax(eq, axis=0).astype(jnp.int32)
+
+    is_new = occupied_r & ~has_match
+    free = gid_l == 0
+    free_rank = jnp.cumsum(free.astype(jnp.int32)) - 1
+    # rank → local slot index (unassigned ranks point out of bounds)
+    slot_of_rank = (
+        jnp.full(r_local, r_local, jnp.int32)
+        .at[jnp.where(free, free_rank, r_local)]
+        .set(jnp.arange(r_local, dtype=jnp.int32), mode="drop")
+    )
+    new_rank = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+    n_new = jnp.sum(is_new.astype(jnp.int32))
+    n_free = jnp.sum(free.astype(jnp.int32))
+    overflow = n_new > n_free
+
+    new_slot = slot_of_rank[jnp.clip(new_rank, 0, r_local - 1)]
+    target = jnp.where(is_new, new_slot, match_idx)
+    target = jnp.where(occupied_r, target, r_local)  # empty remote slots: drop
+
+    ctx_gid = gid_l.at[target].set(gid_r, mode="drop")
+    # scatter remote columns into local slot positions, bucket-rowwise
+    remote_dense = (
+        jnp.zeros_like(max_l).at[:, target].max(max_r, mode="drop")
+    )
+    ctx_max = jnp.maximum(max_l, remote_dense)
+
+    remap = jnp.where(occupied_r, target, -1).astype(jnp.int32)
+    return MergedContext(ctx_gid, ctx_max, remap, remote_dense, overflow)
+
+
+def encode_dot(node: jnp.ndarray, ctr: jnp.ndarray) -> jnp.ndarray:
+    """Pack a (local-slot, counter) dot into one u64 sort/search key."""
+    return (node.astype(jnp.uint64) << jnp.uint64(32)) | ctr.astype(jnp.uint64)

@@ -1,0 +1,75 @@
+"""Device-resident digest tree — the sync index (reference: ``merkle_map`` dep).
+
+The reference indexes its state with a MerkleMap: a hash tree over keys
+whose level-by-level comparison locates divergent keys in O(diff) work
+(call sites ``causal_crdt.ex:94-96,254-255``). Crucially it hashes the
+**internal** dot-map representation, so replicas holding the same user
+value under different dots still sync (test ``causal_crdt_test.exs:154-171``).
+
+TPU-native redesign: keys land in ``L = 2**depth`` leaf buckets by key
+hash; each bucket's digest is the wrapping-u32 **sum** of its alive
+entries' content hashes (commutative → order-free scatter-add, and
+incrementally updatable). Entry hashes cover (key, value digest, ts, dot)
+— the internal representation, preserving the reference property above.
+Parent levels combine children through an asymmetric mix so sibling order
+matters. The whole tree is (re)built in one fused device call; the
+continuation ping-pong of the reference becomes a bounded-frontier level
+walk over these arrays (:mod:`delta_crdt_ex_tpu.runtime.sync`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from delta_crdt_ex_tpu.models.state import DotStore
+
+_M1 = jnp.uint64(0xBF58476D1CE4E5B9)
+_M2 = jnp.uint64(0x94D049BB133111EB)
+_P1 = jnp.uint32(0x85EBCA6B)
+_P2 = jnp.uint32(0xC2B2AE35)
+
+
+def _mix64(x: jnp.ndarray) -> jnp.ndarray:
+    x = (x ^ (x >> jnp.uint64(30))) * _M1
+    x = (x ^ (x >> jnp.uint64(27))) * _M2
+    return x ^ (x >> jnp.uint64(31))
+
+
+def _mix32(x: jnp.ndarray) -> jnp.ndarray:
+    x = (x ^ (x >> jnp.uint32(16))) * _P1
+    x = (x ^ (x >> jnp.uint32(13))) * _P2
+    return x ^ (x >> jnp.uint32(16))
+
+
+def entry_hashes(state: DotStore) -> jnp.ndarray:
+    """uint32[C] content hash of each entry (replica-independent: uses the
+    writer's global id, not the local slot)."""
+    gid = state.entry_gid()
+    h = _mix64(
+        state.key
+        ^ _mix64(gid ^ state.ctr.astype(jnp.uint64))
+        ^ _mix64(state.ts.astype(jnp.uint64) ^ (state.valh.astype(jnp.uint64) << jnp.uint64(32)))
+    )
+    return (h ^ (h >> jnp.uint64(32))).astype(jnp.uint32)
+
+
+def leaf_digests(state: DotStore, depth: int) -> jnp.ndarray:
+    """uint32[2**depth] per-bucket digests (sum of alive entry hashes)."""
+    num_buckets = 1 << depth
+    bucket = (state.key & jnp.uint64(num_buckets - 1)).astype(jnp.int32)
+    h = entry_hashes(state) * state.alive.astype(jnp.uint32)
+    return jnp.zeros(num_buckets, jnp.uint32).at[bucket].add(h)
+
+
+def digest_tree(state: DotStore, depth: int) -> list[jnp.ndarray]:
+    """All tree levels, root first: ``[u32[1], u32[2], …, u32[2**depth]]``.
+
+    Level ``d`` node ``i`` covers leaf buckets ``[i*2**(depth-d), …)``.
+    """
+    levels = [leaf_digests(state, depth)]
+    for _ in range(depth):
+        cur = levels[-1].reshape(-1, 2)
+        left = _mix32(cur[:, 0] ^ _P1)
+        right = _mix32(cur[:, 1] ^ _P2)
+        levels.append(left + (right << jnp.uint32(1)) + jnp.uint32(0x9E3779B9))
+    return levels[::-1]

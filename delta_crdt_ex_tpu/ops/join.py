@@ -1,0 +1,183 @@
+"""The lattice join — the hot kernel of the framework.
+
+Implements the reference's causal δ-join (``AWLWWMap.join/3``,
+``aw_lww_map.ex:153-209``) over the tensorised dot store, restricted — as
+in the reference — to a key subset (`keys` there, a leaf-bucket mask here;
+``join_or_maps`` passes non-listed keys through untouched,
+``aw_lww_map.ex:185-188``).
+
+Per-key dot-set join ``(s1∩s2) ∪ (s1∖c2) ∪ (s2∖c1)`` (``aw_lww_map.ex:
+196-209``) becomes three fused masks over entry arrays:
+
+- keep a participating local entry iff its dot is present in the delta
+  (∩) or not covered by the delta's context (s1∖c2);
+- insert a delta entry iff its dot is not covered by the local context
+  (s2∖c1) — the local context row of the entry's bucket covers every dot
+  the replica has ever observed there (alive or removed), so coverage
+  alone prevents duplicate or resurrecting inserts;
+- context union = per-replica max, bucket-rowwise (``Dots.union``,
+  ``aw_lww_map.ex:45-52``). Unsynced buckets' rows arrive as zeros and
+  union as no-ops, so a bounded partial sync can never over-advance the
+  receiver's context (see :mod:`delta_crdt_ex_tpu.models.state`).
+
+Everything is static-shaped; `ok=False` signals the host to grow a
+capacity tier and retry (the only data-dependent escape hatch).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from delta_crdt_ex_tpu.models.state import DotStore
+from delta_crdt_ex_tpu.ops.dots import merge_contexts
+from delta_crdt_ex_tpu.ops.membership import dots_present
+
+
+class JoinResult(NamedTuple):
+    state: DotStore
+    ok: jnp.ndarray  # bool: capacity sufficed (result invalid otherwise)
+    n_inserted: jnp.ndarray  # int32
+    n_killed: jnp.ndarray  # int32
+
+
+def _bucket(key: jnp.ndarray, num_buckets: int) -> jnp.ndarray:
+    return (key & jnp.uint64(num_buckets - 1)).astype(jnp.int32)
+
+
+def join(
+    local: DotStore,
+    remote: DotStore,
+    bucket_mask: jnp.ndarray | None = None,
+) -> JoinResult:
+    """Join a remote delta/state into the local state.
+
+    ``remote`` may be a reconstituted slice (anti-entropy path: entries +
+    context rows of exactly the synced buckets, zeros elsewhere) or a full
+    peer state (mesh gossip). ``bucket_mask`` (bool[L]) limits
+    reconciliation to the synced key buckets; ``None`` reconciles all keys.
+    Both states must share the same bucket count L.
+    """
+    c = local.capacity
+    num_buckets = local.num_buckets
+
+    merged = merge_contexts(local.ctx_gid, local.ctx_max, remote.ctx_gid, remote.ctx_max)
+
+    # Remote entries re-expressed in local slot indexing.
+    node_r = merged.remap[remote.node]
+    node_r_safe = jnp.clip(node_r, 0, local.replica_capacity - 1)
+
+    bucket_l = _bucket(local.key, num_buckets)
+    bucket_r = _bucket(remote.key, num_buckets)
+    if bucket_mask is None:
+        in_bucket_l = jnp.ones(c, bool)
+        in_bucket_r = jnp.ones(remote.capacity, bool)
+    else:
+        in_bucket_l = bucket_mask[bucket_l]
+        in_bucket_r = bucket_mask[bucket_r]
+
+    # s2 ∖ c1 — delta entries this replica's bucket row has never covered.
+    covered_r = local.ctx_max[bucket_r, node_r_safe] >= remote.ctr
+    insert_mask = remote.alive & in_bucket_r & ~covered_r & (node_r >= 0)
+
+    # (s1 ∩ s2) ∪ (s1 ∖ c2) — survivors among participating local entries.
+    participating = local.alive & in_bucket_l
+    covered_l = merged.remote_dense[bucket_l, local.node] >= local.ctr
+    present_l = dots_present(
+        local.node, local.ctr, node_r_safe, remote.ctr, remote.alive & in_bucket_r
+    )
+    alive1 = local.alive & (~participating | ~covered_l | present_l)
+
+    # Scatter inserts into free slots (rank-matched via cumsums).
+    free = ~alive1
+    free_rank = jnp.cumsum(free.astype(jnp.int32)) - 1
+    slot_of_rank = (
+        jnp.full(c, c, jnp.int32)
+        .at[jnp.where(free, free_rank, c)]
+        .set(jnp.arange(c, dtype=jnp.int32), mode="drop")
+    )
+    ins_rank = jnp.cumsum(insert_mask.astype(jnp.int32)) - 1
+    n_ins = jnp.sum(insert_mask.astype(jnp.int32))
+    n_free = jnp.sum(free.astype(jnp.int32))
+    ok = (n_ins <= n_free) & ~merged.overflow
+
+    tgt = jnp.where(insert_mask, slot_of_rank[jnp.clip(ins_rank, 0, c - 1)], c)
+
+    state = DotStore(
+        key=local.key.at[tgt].set(remote.key, mode="drop"),
+        valh=local.valh.at[tgt].set(remote.valh, mode="drop"),
+        ts=local.ts.at[tgt].set(remote.ts, mode="drop"),
+        node=local.node.at[tgt].set(node_r_safe, mode="drop"),
+        ctr=local.ctr.at[tgt].set(remote.ctr, mode="drop"),
+        alive=alive1.at[tgt].set(True, mode="drop"),
+        ctx_gid=merged.ctx_gid,
+        ctx_max=merged.ctx_max,
+    )
+    n_killed = jnp.sum((local.alive & ~alive1).astype(jnp.int32))
+    return JoinResult(state, ok, n_ins, n_killed)
+
+
+class EntrySlice(NamedTuple):
+    """Wire format of the anti-entropy data plane: compacted entry columns
+    plus the sender's gid table. Context rows for the synced buckets are
+    gathered separately (host-driven, bounded by max_sync_size) and the
+    receiver reconstitutes a :class:`DotStore` via :func:`slice_to_store`."""
+
+    key: jnp.ndarray  # uint64[S]
+    valh: jnp.ndarray  # uint32[S]
+    ts: jnp.ndarray  # int64[S]
+    node: jnp.ndarray  # int32[S]
+    ctr: jnp.ndarray  # uint32[S]
+    alive: jnp.ndarray  # bool[S]
+    ctx_gid: jnp.ndarray  # uint64[R]
+
+
+class SliceResult(NamedTuple):
+    slice: EntrySlice
+    count: jnp.ndarray  # int32: entries selected
+    ok: jnp.ndarray  # bool: slice capacity sufficed
+
+
+def extract_buckets(state: DotStore, bucket_mask: jnp.ndarray, out_size: int) -> SliceResult:
+    """Extract the replica's entries for a set of leaf buckets as a slice.
+
+    The anti-entropy data plane: the originator ships exactly its entries
+    for the differing buckets, with the matching context rows attached
+    (reference: ``Map.take(crdt_state.value, keys)`` + ``dots:
+    diff.dots``, ``causal_crdt.ex:115-119`` — except our context travels
+    bucket-rowwise, see module docstring).
+    """
+    sel = state.alive & bucket_mask[_bucket(state.key, state.num_buckets)]
+    rank = jnp.cumsum(sel.astype(jnp.int32)) - 1
+    count = jnp.sum(sel.astype(jnp.int32))
+    ok = count <= out_size
+    tgt = jnp.where(sel, rank, out_size)
+
+    def compact(col, dtype):
+        return jnp.zeros(out_size, dtype).at[tgt].set(col, mode="drop")
+
+    out = EntrySlice(
+        key=compact(state.key, jnp.uint64),
+        valh=compact(state.valh, jnp.uint32),
+        ts=compact(state.ts, jnp.int64),
+        node=compact(state.node, jnp.int32),
+        ctr=compact(state.ctr, jnp.uint32),
+        alive=jnp.zeros(out_size, bool).at[tgt].set(sel, mode="drop"),
+        ctx_gid=state.ctx_gid,
+    )
+    return SliceResult(out, count, ok)
+
+
+def slice_to_store(
+    entry_cols: dict, ctx_rows: jnp.ndarray, row_buckets: jnp.ndarray, num_buckets: int
+) -> DotStore:
+    """Reconstitute a received slice as a DotStore: context rows scattered
+    into a dense [L, R] (zeros for unsynced buckets → union no-ops)."""
+    r = entry_cols["ctx_gid"].shape[0]
+    dense = (
+        jnp.zeros((num_buckets, r), jnp.uint32)
+        .at[row_buckets]
+        .set(ctx_rows, mode="drop")
+    )
+    return DotStore(ctx_max=dense, **entry_cols)

@@ -1,0 +1,38 @@
+"""Dot-membership kernel: which local entries' dots appear in a delta.
+
+The reference's per-key ``MapSet.intersection`` (``aw_lww_map.ex:196-209``)
+reduces, at the dot level, to a set-membership test: dots are globally
+unique and each dot determines its entry, so ``e ∈ s1 ∩ s2 ⟺ dot(e) ∈
+dots(s2)``. Rather than an O(C·D) compare matrix, dots are packed into u64
+keys, the (small) delta side is sorted once, and the state side probes via
+``searchsorted`` — O(D log D + C log D), bandwidth-bound at ~O(C).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from delta_crdt_ex_tpu.ops.dots import encode_dot
+
+_SENTINEL = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def dots_present(
+    node_l: jnp.ndarray,
+    ctr_l: jnp.ndarray,
+    node_r: jnp.ndarray,
+    ctr_r: jnp.ndarray,
+    mask_r: jnp.ndarray,
+) -> jnp.ndarray:
+    """bool[C]: local dot (node_l, ctr_l) present among masked remote dots.
+
+    Both sides must already be expressed in the same (local) slot indexing
+    (see :func:`delta_crdt_ex_tpu.ops.dots.merge_contexts`).
+    """
+    d = node_r.shape[0]
+    dk_r = jnp.where(mask_r, encode_dot(node_r, ctr_r), _SENTINEL)
+    s = jnp.sort(dk_r)
+    dk_l = encode_dot(node_l, ctr_l)
+    pos = jnp.searchsorted(s, dk_l)
+    hit = s[jnp.clip(pos, 0, d - 1)] == dk_l
+    return hit & (pos < d) & (dk_l != _SENTINEL)

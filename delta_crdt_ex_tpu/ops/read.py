@@ -1,0 +1,120 @@
+"""LWW read kernels (reference ``AWLWWMap.read``, ``aw_lww_map.ex:211-224``).
+
+The reference resolves every key with ``Enum.max_by`` on the timestamp,
+leaving ties to map iteration order. Here ties break deterministically by
+``(ts, writer gid, counter)`` so reads are replica- and order-independent
+(a strict improvement, documented in SURVEY §7 "Hard parts").
+
+Three paths:
+
+- :func:`winners_for_keys` — O(k·C) masked lexicographic argmax, vmapped
+  over a small key batch (the per-mutation diff path);
+- :func:`winner_mask` — sort-based winner selection over all (or a bucket
+  subset of) entries in one ``lax.sort``;
+- :func:`winner_slice` — winners of a bucket subset compacted into a
+  fixed-size output (the sync-round diff/callback path: bounded like the
+  sync itself, no O(C) host transfer).
+
+Winners carry the writer's **global** id so the host can look up value
+payloads by dot without mirroring device slot tables.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from delta_crdt_ex_tpu.models.state import DotStore
+
+_SENTINEL = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+_TS_MIN = jnp.int64(-(2**62))
+
+
+class Winners(NamedTuple):
+    found: jnp.ndarray  # bool[k]
+    gid: jnp.ndarray  # uint64[k] writer global id
+    ctr: jnp.ndarray  # uint32[k]
+    valh: jnp.ndarray  # uint32[k]
+    ts: jnp.ndarray  # int64[k]
+
+
+def winners_for_keys(state: DotStore, keys: jnp.ndarray) -> Winners:
+    """Current LWW winner entry for each queried key hash."""
+    gid = state.entry_gid()
+
+    def one(k):
+        m = state.alive & (state.key == k)
+        found = jnp.any(m)
+        ts_m = jnp.where(m, state.ts, _TS_MIN)
+        m1 = m & (ts_m == jnp.max(ts_m))
+        gid_m = jnp.where(m1, gid, 0)
+        m2 = m1 & (gid_m == jnp.max(gid_m))
+        ctr_m = jnp.where(m2, state.ctr, 0)
+        m3 = m2 & (ctr_m == jnp.max(ctr_m))
+        idx = jnp.argmax(m3)
+        return found, gid[idx], state.ctr[idx], state.valh[idx], state.ts[idx]
+
+    f, g, c, v, t = jax.vmap(one)(keys)
+    return Winners(f, g, c, v, t)
+
+
+def winner_mask(state: DotStore, participate: jnp.ndarray | None = None) -> jnp.ndarray:
+    """bool[C]: marks the LWW-winning entry of every (participating) key.
+
+    One multi-operand ``lax.sort`` by (key, ts, gid, ctr); the last entry
+    of each key run wins.
+    """
+    c = state.capacity
+    mask = state.alive if participate is None else (state.alive & participate)
+    skey = jnp.where(mask, state.key, _SENTINEL)
+    idx = jnp.arange(c, dtype=jnp.int32)
+    skey_s, _, _, _, idx_s = jax.lax.sort(
+        (skey, state.ts, state.entry_gid(), state.ctr, idx), num_keys=4
+    )
+    last_of_run = jnp.concatenate([skey_s[1:] != skey_s[:-1], jnp.ones(1, bool)])
+    win_sorted = last_of_run & (skey_s != _SENTINEL)
+    return jnp.zeros(c, bool).at[idx_s].set(win_sorted)
+
+
+class WinnerSlice(NamedTuple):
+    count: jnp.ndarray  # int32 (valid prefix length)
+    ok: jnp.ndarray  # bool (out_size sufficed)
+    key: jnp.ndarray  # uint64[S]
+    gid: jnp.ndarray  # uint64[S]
+    ctr: jnp.ndarray  # uint32[S]
+    valh: jnp.ndarray  # uint32[S]
+    ts: jnp.ndarray  # int64[S]
+
+
+def winner_slice(
+    state: DotStore,
+    bucket_mask: jnp.ndarray | None,
+    out_size: int,
+) -> WinnerSlice:
+    """Per-key LWW winners within a bucket subset, compacted to ``out_size``."""
+    if bucket_mask is None:
+        participate = None
+    else:
+        bucket = (state.key & jnp.uint64(state.num_buckets - 1)).astype(jnp.int32)
+        participate = bucket_mask[bucket]
+    win = winner_mask(state, participate)
+
+    rank = jnp.cumsum(win.astype(jnp.int32)) - 1
+    count = jnp.sum(win.astype(jnp.int32))
+    ok = count <= out_size
+    tgt = jnp.where(win, rank, out_size)
+
+    def compact(col, dtype):
+        return jnp.zeros(out_size, dtype).at[tgt].set(col, mode="drop")
+
+    return WinnerSlice(
+        count=count,
+        ok=ok,
+        key=compact(state.key, jnp.uint64),
+        gid=compact(state.entry_gid(), jnp.uint64),
+        ctr=compact(state.ctr, jnp.uint32),
+        valh=compact(state.valh, jnp.uint32),
+        ts=compact(state.ts, jnp.int64),
+    )

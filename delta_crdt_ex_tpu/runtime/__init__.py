@@ -1,0 +1,5 @@
+from delta_crdt_ex_tpu.runtime.replica import Replica
+from delta_crdt_ex_tpu.runtime.storage import MemoryStorage, Storage
+from delta_crdt_ex_tpu.runtime.transport import LocalTransport
+
+__all__ = ["LocalTransport", "MemoryStorage", "Replica", "Storage"]

@@ -1,0 +1,40 @@
+"""LWW timestamp source.
+
+The reference stamps adds with ``System.monotonic_time(:nanosecond)``
+(``aw_lww_map.ex:104``) — monotonic per BEAM node, arbitrary offset, so
+cross-replica LWW order is essentially meaningless there (SURVEY §7
+"Hard parts"). We keep the per-replica monotonicity contract but base the
+clock on wall time so cross-replica LWW is at least wall-clock sensible,
+and guarantee strict per-replica increase (ties are impossible within a
+replica). Deterministic logical clocks are injectable for tests.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Clock:
+    """Strictly increasing nanosecond timestamps, wall-clock based."""
+
+    def __init__(self, start: int | None = None):
+        self._last = int(start or 0)
+
+    def next(self) -> int:
+        now = time.time_ns()
+        self._last = now if now > self._last else self._last + 1
+        return self._last
+
+    def observe(self, ts: int) -> None:
+        """Fast-forward past a restored/remote timestamp (crash-restart
+        continuity: restored LWW entries must not out-rank new writes)."""
+        if ts > self._last:
+            self._last = ts
+
+
+class LogicalClock(Clock):
+    """Deterministic test clock: 1, 2, 3, …"""
+
+    def next(self) -> int:
+        self._last += 1
+        return self._last

@@ -1,0 +1,707 @@
+"""Replica driver — the host actor owning one device-resident CRDT state.
+
+TPU-native counterpart of ``DeltaCrdt.CausalCrdt`` (``causal_crdt.ex``):
+where the reference serialises every state transition through a GenServer
+mailbox, this driver serialises through a lock and issues **batched,
+jit-compiled kernel calls** against the device state. Capabilities map
+1:1 (SURVEY §2.2):
+
+- mutate (sync) / mutate_async → queued mutation batch, flushed before
+  any read/sync (mailbox-order semantics of ``handle_call``/``handle_cast``,
+  ``causal_crdt.ex:192-198``);
+- periodic anti-entropy with ≤1 in-flight sync per neighbour, cleared by
+  acks (``outstanding_syncs``, ``causal_crdt.ex:25,264-287,406-412``);
+- neighbour monitoring with pruning on death (``:127-145,291-314``);
+- ``on_diffs`` change feed with the reference's exact emission rules
+  (no-op writes are silent, a ``nil`` value reads as a remove diff —
+  ``delta_subscriber_test.exs:23-27``);
+- pluggable storage with crash-rehydrate keeping the node id (dot
+  continuity, ``causal_crdt.ex:220-231``);
+- telemetry ``(delta_crdt, sync, done)`` on every merge (``:396-398``).
+
+Capacity is tiered: kernels signal overflow via ``ok`` flags and the
+driver grows the state (or slice buffers) and retries — the only
+data-dependent control flow, and it lives on the host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import secrets
+import threading
+import time
+from typing import Any, Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from delta_crdt_ex_tpu.utils.hashing import key_hash64, value_hash32
+from delta_crdt_ex_tpu.models.aw_lww_map import AWLWWMap
+from delta_crdt_ex_tpu.models.state import DotStore
+from delta_crdt_ex_tpu.ops.apply import OP_ADD, OP_CLEAR, OP_PAD, OP_REMOVE
+from delta_crdt_ex_tpu.runtime import sync as sync_proto, telemetry
+from delta_crdt_ex_tpu.runtime.clock import Clock
+from delta_crdt_ex_tpu.runtime.storage import Snapshot, Storage
+from delta_crdt_ex_tpu.runtime.transport import Down, LocalTransport, default_transport
+
+logger = logging.getLogger("delta_crdt_ex_tpu")
+
+_COLUMNS = ("key", "valh", "ts", "node", "ctr", "alive", "ctx_gid", "ctx_max")
+_SLICE_COLUMNS = ("key", "valh", "ts", "node", "ctr", "alive", "ctx_gid")
+
+
+def _pow2(n: int, floor: int = 8) -> int:
+    c = floor
+    while c < n:
+        c *= 2
+    return c
+
+
+class Replica:
+    def __init__(
+        self,
+        crdt_module=AWLWWMap,
+        *,
+        name: Any = None,
+        node_id: int | None = None,
+        sync_interval: float = 0.2,
+        max_sync_size: int | str = 200,
+        on_diffs: Callable | tuple | None = None,
+        storage_module: Storage | None = None,
+        storage_mode: str = "every_op",
+        transport: LocalTransport | None = None,
+        clock: Clock | None = None,
+        capacity: int = 1024,
+        replica_capacity: int = 64,
+        tree_depth: int = 12,
+        levels_per_round: int = 8,
+    ):
+        # max_sync_size validation (reference raises, causal_crdt.ex:52-62)
+        if max_sync_size == "infinite":
+            self.max_sync_size: float = float("inf")
+        elif isinstance(max_sync_size, int) and not isinstance(max_sync_size, bool) and max_sync_size > 0:
+            self.max_sync_size = max_sync_size
+        else:
+            raise ValueError(f"{max_sync_size!r} is not a valid max_sync_size")
+
+        self.model = crdt_module
+        self.name = name if name is not None else f"crdt-{secrets.token_hex(6)}"
+        self.sync_interval = sync_interval
+        self.on_diffs = on_diffs
+        self.storage_module = storage_module
+        self.storage_mode = storage_mode
+        self.tree_depth = tree_depth
+        self.num_buckets = 1 << tree_depth
+        self.levels_per_round = levels_per_round
+        self.transport = transport or default_transport()
+        self.clock = clock or Clock()
+
+        self._lock = threading.RLock()
+        self._pending: list[tuple[str, Any, Any]] = []  # (op, key_term, value)
+        self._payloads: dict[tuple[int, int], tuple[Any, Any]] = {}
+        self._key_terms: dict[int, Any] = {}
+        self._neighbours: list[Any] = []
+        self._monitors: set[Any] = set()
+        self._outstanding: dict[Any, int] = {}
+        self._tree: list[np.ndarray] | None = None
+        self._read_cache: dict | None = None
+        self._seq = 0
+        self._slice_size = 1024
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+
+        # register under the bare name; self-identify to peers with the
+        # transport's canonical (routable-from-anywhere) address — the
+        # {name, node} analog (causal_crdt_test.exs:68-78)
+        self.addr = self.transport.canonical_addr(self.name)
+
+        snap = storage_module.read(self.name) if storage_module else None
+        if snap is not None:
+            self._rehydrate(snap)
+        else:
+            self.node_id = node_id if node_id is not None else (secrets.randbits(63) | 1)
+            state = self.model.new(capacity, replica_capacity, self.num_buckets)
+            # claim slot 0 of the context table for our own gid
+            state = dataclasses.replace(
+                state, ctx_gid=state.ctx_gid.at[0].set(jnp.uint64(self.node_id))
+            )
+            self.state = state
+            self.self_slot = 0
+
+        self.transport.register(self.name, self)
+
+    # ------------------------------------------------------------------
+    # rehydrate / persist (reference causal_crdt.ex:216-250)
+
+    def _rehydrate(self, snap: Snapshot) -> None:
+        self.node_id = snap.node_id
+        self._seq = snap.sequence_number
+        self.state = DotStore(**{c: jnp.asarray(snap.arrays[c]) for c in _COLUMNS})
+        gids = snap.arrays["ctx_gid"]
+        slots = np.nonzero(gids == np.uint64(self.node_id))[0]
+        assert len(slots) == 1, "rehydrated state must contain our node id"
+        self.self_slot = int(slots[0])
+        self._payloads = dict(snap.payloads)
+        self._key_terms = dict(snap.key_terms)
+        self.clock.observe(snap.last_ts)
+
+    def _snapshot(self) -> Snapshot:
+        return Snapshot(
+            node_id=self.node_id,
+            sequence_number=self._seq,
+            arrays={c: np.asarray(getattr(self.state, c)) for c in _COLUMNS},
+            payloads=dict(self._payloads),
+            key_terms=dict(self._key_terms),
+            last_ts=self.clock._last,
+        )
+
+    def _persist(self) -> None:
+        if self.storage_module is not None and self.storage_mode == "every_op":
+            self.storage_module.write(self.name, self._snapshot())
+
+    def checkpoint(self) -> None:
+        """Explicit snapshot (for storage_mode="interval")."""
+        with self._lock:
+            if self.storage_module is not None:
+                self.storage_module.write(self.name, self._snapshot())
+
+    # ------------------------------------------------------------------
+    # public API (facade parity: delta_crdt.ex:97-137)
+
+    def mutate(self, f: str, args: list, timeout: float | None = None) -> None:
+        with self._lock:
+            self._enqueue(f, args)
+            self._flush()
+
+    def mutate_async(self, f: str, args: list) -> None:
+        with self._lock:
+            self._enqueue(f, args)
+        self.notify()
+
+    def _enqueue(self, f: str, args: list) -> None:
+        ops = self.model.OPS
+        if f not in ops:
+            raise ValueError(f"unknown operation {f!r}; available: {sorted(ops)}")
+        _, arity = ops[f]
+        if len(args) != arity:
+            raise ValueError(f"{f} expects {arity} argument(s), got {len(args)}")
+        if f == "add":
+            self._pending.append(("add", args[0], args[1]))
+        elif f == "remove":
+            self._pending.append(("remove", args[0], None))
+        else:
+            self._pending.append(("clear", None, None))
+
+    def read(self, timeout: float | None = None) -> dict:
+        with self._lock:
+            self._flush()
+            if self._read_cache is None:
+                self._read_cache = self._read_all()
+            return dict(self._read_cache)
+
+    def read_keys(self, key_terms: list) -> dict:
+        """Partial read (reference ``AWLWWMap.read/2``, ``aw_lww_map.ex:218-224``)."""
+        with self._lock:
+            self._flush()
+            hashes = [key_hash64(k) for k in key_terms]
+            k = _pow2(max(len(hashes), 1))
+            arr = np.zeros(k, np.uint64)
+            arr[: len(hashes)] = hashes
+            w = self.model.winners_for_keys(self.state, jnp.asarray(arr))
+            found = np.asarray(w.found)
+            gid = np.asarray(w.gid)
+            ctr = np.asarray(w.ctr)
+            out = {}
+            for i, term in enumerate(key_terms):
+                if found[i]:
+                    out[term] = self._payloads[(int(gid[i]), int(ctr[i]))][1]
+            return out
+
+    def set_neighbours(self, neighbours: list) -> None:
+        """One-way sync edges (reference ``{:set_neighbours, …}``,
+        ``causal_crdt.ex:147-178``): prunes monitors/in-flight slots for
+        removed peers, then syncs immediately."""
+        addrs = [n.addr if isinstance(n, Replica) else n for n in neighbours]
+        with self._lock:
+            removed = set(self._monitors) - set(addrs)
+            for addr in removed:
+                self.transport.demonitor(self.addr, addr)
+            self._neighbours = list(addrs)
+            self._monitors &= set(addrs)
+            self._outstanding = {a: v for a, v in self._outstanding.items() if a in addrs}
+            self.sync_to_all()
+
+    # ------------------------------------------------------------------
+    # local mutation batch
+
+    def _flush(self) -> None:
+        if not self._pending:
+            return
+        batch = self._pending
+        self._pending = []
+        n = len(batch)
+        k = _pow2(n)
+
+        op = np.full(k, OP_PAD, np.int32)
+        key = np.zeros(k, np.uint64)
+        valh = np.zeros(k, np.uint32)
+        ts = np.zeros(k, np.int64)
+        any_clear = False
+        for i, (f, key_term, value) in enumerate(batch):
+            if f == "add":
+                op[i] = OP_ADD
+                key[i] = key_hash64(key_term)
+                valh[i] = value_hash32(value)
+            elif f == "remove":
+                op[i] = OP_REMOVE
+                key[i] = key_hash64(key_term)
+            else:
+                op[i] = OP_CLEAR
+                any_clear = True
+            ts[i] = self.clock.next()
+            if f != "clear":
+                self._key_terms[key[i].item()] = key_term
+
+        # touched keys for the diff/callback: the batch keys (clear implies
+        # every currently-present key; the full-map pass below covers it)
+        touched: dict[int, Any] = {}
+        for i, (f, key_term, _v) in enumerate(batch):
+            if f != "clear":
+                touched[int(key[i])] = key_term
+
+        w_before = self._batch_winner_records(touched, any_clear)
+        res = self._apply_with_growth(op, key, valh, ts)
+        self._seq += 1
+
+        # register payloads for surviving adds (host mirror of the kernel's
+        # batch-shadowing: last op per key wins, a clear shadows everything
+        # before it). Keyed by key hash: terms may be unhashable.
+        ctr_assigned = np.asarray(res.ctr_assigned)
+        survivor: dict[int, int] = {}
+        blocked = False
+        for i in range(n - 1, -1, -1):
+            f, key_term, value = batch[i]
+            if f == "clear":
+                blocked = True
+            elif not blocked and int(key[i]) not in survivor:
+                survivor[int(key[i])] = i if f == "add" else -1
+        for _kh, i in survivor.items():
+            if i >= 0:
+                _f, key_term, value = batch[i]
+                self._payloads[(self.node_id, int(ctr_assigned[i]))] = (key_term, value)
+
+        w_after = self._batch_winner_records(touched, any_clear)
+        touched_all = dict(touched)
+        for kh in set(w_before) | set(w_after):
+            touched_all.setdefault(kh, self._key_terms.get(kh))
+        self._emit_diffs(touched_all, w_before, w_after)
+        self._persist()
+
+    def _batch_winner_records(self, touched: dict[int, Any], full: bool) -> dict[int, tuple]:
+        """Winner records for a mutation batch's diff. Small batches use the
+        O(k·C) vmapped argmax; a batch containing ``clear`` touches every
+        key, so it uses one sort-based winner_slice pass instead."""
+        if full:
+            _w, recs = self._winners_in_buckets(None)
+            return recs
+        if not touched:
+            return {}
+        tkeys = np.zeros(_pow2(max(len(touched), 1)), np.uint64)
+        tkeys[: len(touched)] = list(touched.keys())
+        w = self.model.winners_for_keys(self.state, jnp.asarray(tkeys))
+        return self._winner_records(touched, w)
+
+    def _apply_with_growth(self, op, key, valh, ts):
+        jop, jkey, jvalh, jts = map(jnp.asarray, (op, key, valh, ts))
+        while True:
+            res = self.model.apply_batch(
+                self.state, jnp.int32(self.self_slot), jop, jkey, jvalh, jts
+            )
+            if bool(res.ok):
+                self.state = res.state
+                return res
+            self._grow(extra_entries=int(np.sum(op == OP_ADD)), extra_gids=0)
+
+    def _grow(self, extra_entries: int, extra_gids: int) -> None:
+        c = self.state.capacity
+        need_c = int(self.state.num_alive()) + extra_entries
+        new_c = _pow2(need_c, floor=c)  # stays at c when entries fit
+        r = self.state.replica_capacity
+        used_r = int(np.sum(np.asarray(self.state.ctx_gid) != 0))
+        new_r = _pow2(used_r + extra_gids, floor=r)
+        if new_c == c and new_r == r:
+            new_c = c * 2  # safety: the kernel said no — always make progress
+        self.state = self.state.grow(new_c, new_r)
+        telemetry.execute(
+            telemetry.CAPACITY_GROWN,
+            {"capacity": new_c, "replica_capacity": new_r},
+            {"name": self.name},
+        )
+
+    # ------------------------------------------------------------------
+    # diffs, callback, telemetry (reference causal_crdt.ex:344-404)
+
+    def _winner_records(self, keys: dict[int, Any], w) -> dict[int, tuple]:
+        found = np.asarray(w.found)
+        gid = np.asarray(w.gid)
+        ctr = np.asarray(w.ctr)
+        valh = np.asarray(w.valh)
+        ts = np.asarray(w.ts)
+        out = {}
+        for i, kh in enumerate(keys):
+            if found[i]:
+                out[kh] = (int(gid[i]), int(ctr[i]), int(valh[i]), int(ts[i]))
+        return out
+
+    def _after_update(self, touched: dict[int, Any], w_before, w_after) -> None:
+        before = self._winner_records(touched, w_before)
+        after = self._winner_records(touched, w_after)
+        self._emit_diffs(touched, before, after)
+
+    def _emit_diffs(self, touched: dict[int, Any], before: dict, after: dict) -> None:
+        """Reference emission rules (``causal_crdt.ex:344-381``): telemetry
+        counts internal (dot-level) changes; the user callback compares
+        read values, so no-op re-adds are silent and a present-but-``None``
+        value emits a remove diff."""
+        internal_changed = 0
+        diffs = []
+        for kh, term in touched.items():
+            b, a = before.get(kh), after.get(kh)
+            if b != a:
+                internal_changed += 1
+            old_rec = self._payloads.get((b[0], b[1])) if b else None
+            new_rec = self._payloads.get((a[0], a[1])) if a else None
+            old_val = old_rec[1] if old_rec else None
+            new_val = new_rec[1] if new_rec else None
+            if old_val == new_val:
+                continue
+            if new_val is None:
+                diffs.append(("remove", term))
+            else:
+                diffs.append(("add", term, new_val))
+
+        self._tree = None
+        self._read_cache = None
+        telemetry.execute(
+            telemetry.SYNC_DONE,
+            {"keys_updated_count": internal_changed},
+            {"name": self.name},
+        )
+        if diffs and self.on_diffs is not None:
+            if isinstance(self.on_diffs, tuple):
+                fn, extra = self.on_diffs
+                fn(*extra, diffs)
+            else:
+                self.on_diffs(diffs)
+
+    def _read_all(self) -> dict:
+        out = {}
+        for term, value in self._read_all_items():
+            try:
+                out[term] = value
+            except TypeError:
+                raise TypeError(
+                    f"key term {term!r} is unhashable in Python; use read_items() "
+                    "for maps with unhashable keys"
+                ) from None
+        return out
+
+    def _read_all_items(self) -> list[tuple[Any, Any]]:
+        w = self.model.winner_slice(self.state, None, out_size=self.state.capacity)
+        count = int(w.count)
+        key = np.asarray(w.key)[:count]
+        gid = np.asarray(w.gid)[:count]
+        ctr = np.asarray(w.ctr)[:count]
+        return [
+            (
+                self._key_terms[int(key[i])],
+                self._payloads[(int(gid[i]), int(ctr[i]))][1],
+            )
+            for i in range(count)
+        ]
+
+    def read_items(self) -> list[tuple[Any, Any]]:
+        """Read as (key, value) pairs — supports unhashable key terms
+        (Python dicts can't key on them; Elixir maps can)."""
+        with self._lock:
+            self._flush()
+            return self._read_all_items()
+
+    # ------------------------------------------------------------------
+    # anti-entropy (reference causal_crdt.ex:252-335)
+
+    def _ensure_tree(self) -> list[np.ndarray]:
+        if self._tree is None:
+            levels = self.model.digest_tree(self.state, self.tree_depth)
+            self._tree = [np.asarray(l) for l in levels]
+        return self._tree
+
+    def sync_to_all(self) -> None:
+        """One sync round to all monitored neighbours (reference
+        ``sync_interval_or_state_to_all``, ``causal_crdt.ex:252-289``)."""
+        with self._lock:
+            self._flush()
+            self._monitor_neighbours()
+            tree = self._ensure_tree()
+            root = np.zeros(1, np.int64)
+            for n in list(self._monitors):
+                if n == self.addr or n in self._outstanding:
+                    continue
+                blocks = sync_proto.make_blocks(tree, 0, root, self.levels_per_round)
+                msg = sync_proto.DiffMsg(
+                    originator=self.addr, frm=self.addr, to=n, level=0, idx=root, blocks=blocks
+                )
+                if self.transport.send(n, msg):
+                    self._outstanding[n] = 1
+                else:
+                    logger.debug("tried to sync with a dead neighbour: %r", n)
+
+    def _monitor_neighbours(self) -> None:
+        for n in self._neighbours:
+            if n in self._monitors:
+                continue
+            if self.transport.monitor(self.addr, n):
+                self._monitors.add(n)
+            else:
+                logger.debug("tried to monitor a dead neighbour: %r", n)
+
+    # -- message handlers ------------------------------------------------
+
+    def handle(self, msg) -> None:
+        with self._lock:
+            if isinstance(msg, sync_proto.DiffMsg):
+                self._handle_diff(msg)
+            elif isinstance(msg, sync_proto.GetDiffMsg):
+                self._handle_get_diff(msg)
+            elif isinstance(msg, sync_proto.EntriesMsg):
+                self._handle_entries(msg)
+            elif isinstance(msg, sync_proto.AckMsg):
+                self._outstanding.pop(msg.clear_addr, None)
+            elif isinstance(msg, Down):
+                self._monitors.discard(msg.addr)
+                self._outstanding.pop(msg.addr, None)
+            else:
+                raise TypeError(f"unknown message: {msg!r}")
+
+    def _handle_diff(self, msg: sync_proto.DiffMsg) -> None:
+        self._flush()
+        tree = self._ensure_tree()
+        end_level, end_idx = sync_proto.walk(
+            tree, msg.level, msg.idx, msg.blocks, self.max_sync_size
+        )
+        if len(end_idx) == 0:
+            # trees agree under every compared node ({:ok, []} path)
+            cleared = self.addr if msg.originator != self.addr else msg.frm
+            self.transport.send(msg.originator, sync_proto.AckMsg(clear_addr=cleared))
+            return
+        if end_level == self.tree_depth:
+            buckets = end_idx[: int(min(self.max_sync_size, len(end_idx)))]
+            if msg.originator == self.addr:
+                # walk ended at the originator: ship entries directly
+                self._send_entries(to=msg.frm, buckets=buckets, originator=self.addr)
+                self._outstanding.pop(msg.frm, None)
+            else:
+                self.transport.send(
+                    msg.originator,
+                    sync_proto.GetDiffMsg(
+                        originator=msg.originator, frm=self.addr, to=msg.originator, buckets=buckets
+                    ),
+                )
+            return
+        # continue the ping-pong with our own digests beneath the frontier
+        blocks = sync_proto.make_blocks(tree, end_level, end_idx, self.levels_per_round)
+        self.transport.send(
+            msg.frm,
+            sync_proto.DiffMsg(
+                originator=msg.originator,
+                frm=self.addr,
+                to=msg.frm,
+                level=end_level,
+                idx=end_idx,
+                blocks=blocks,
+            ),
+        )
+
+    def _handle_get_diff(self, msg: sync_proto.GetDiffMsg) -> None:
+        self._flush()
+        self._send_entries(to=msg.frm, buckets=msg.buckets, originator=msg.originator)
+        self._outstanding.pop(msg.frm, None)
+
+    def _send_entries(self, to, buckets: np.ndarray, originator) -> None:
+        buckets = np.asarray(buckets, np.int64)
+        mask = np.zeros(self.num_buckets, bool)
+        mask[buckets] = True
+        jmask = jnp.asarray(mask)
+        while True:
+            res = self.model.extract_buckets(self.state, jmask, out_size=self._slice_size)
+            if bool(res.ok):
+                break
+            self._slice_size *= 2
+        sl = res.slice
+        arrays = {c: np.asarray(getattr(sl, c)) for c in _SLICE_COLUMNS}
+        # context rows for exactly the synced buckets (bucket-atomic sync:
+        # coverage never outruns the shipped entries)
+        arrays["ctx_rows"] = np.asarray(self.state.ctx_max[jnp.asarray(buckets)])
+        gids = arrays["ctx_gid"][arrays["node"]]
+        payloads = {}
+        alive = arrays["alive"]
+        for i in np.nonzero(alive)[0]:
+            dot = (int(gids[i]), int(arrays["ctr"][i]))
+            payloads[dot] = self._payloads[dot]
+        self.transport.send(
+            to,
+            sync_proto.EntriesMsg(
+                originator=originator,
+                frm=self.addr,
+                to=to,
+                buckets=np.asarray(buckets, np.int64),
+                arrays=arrays,
+                payloads=payloads,
+            ),
+        )
+
+    def _handle_entries(self, msg: sync_proto.EntriesMsg) -> None:
+        self._flush()
+        t0 = time.perf_counter()
+        entry_cols = {c: jnp.asarray(msg.arrays[c]) for c in _SLICE_COLUMNS}
+        remote = self.model.slice_to_store(
+            entry_cols,
+            jnp.asarray(msg.arrays["ctx_rows"]),
+            jnp.asarray(msg.buckets),
+            self.num_buckets,
+        )
+        mask = np.zeros(self.num_buckets, bool)
+        mask[msg.buckets] = True
+        jmask = jnp.asarray(mask)
+
+        _w, keys_b = self._winners_in_buckets(jmask)
+        # payloads first: diff values for incoming winners must resolve
+        self._payloads.update(msg.payloads)
+        for _dot, (key_term, _val) in msg.payloads.items():
+            self._key_terms[key_hash64(key_term)] = key_term
+
+        slice_alive = int(np.sum(msg.arrays["alive"]))
+        remote_gids = set(np.asarray(remote.ctx_gid)[np.asarray(remote.ctx_gid) != 0].tolist())
+        while True:
+            res = self.model.join(self.state, remote, jmask)
+            if bool(res.ok):
+                self.state = res.state
+                break
+            local_gids = set(np.asarray(self.state.ctx_gid)[np.asarray(self.state.ctx_gid) != 0].tolist())
+            self._grow(
+                extra_entries=slice_alive,
+                extra_gids=len(remote_gids - local_gids),
+            )
+
+        _w, keys_a = self._winners_in_buckets(jmask)
+        touched: dict[int, Any] = {}
+        for kh in set(keys_b) | set(keys_a):
+            term = self._key_terms.get(kh)
+            if term is not None:
+                touched[kh] = term
+        self._seq += 1
+        self._emit_diffs(touched, keys_b, keys_a)
+        telemetry.execute(
+            telemetry.SYNC_ROUND,
+            {
+                "duration_s": time.perf_counter() - t0,
+                "buckets": int(len(msg.buckets)),
+                "entries": slice_alive,
+            },
+            {"name": self.name},
+        )
+        self._persist()
+
+    def _winners_in_buckets(self, jmask):
+        while True:
+            w = self.model.winner_slice(self.state, jmask, out_size=self._slice_size)
+            if bool(w.ok):
+                break
+            self._slice_size *= 2
+        count = int(w.count)
+        key = np.asarray(w.key)[:count]
+        gid = np.asarray(w.gid)[:count]
+        ctr = np.asarray(w.ctr)[:count]
+        valh = np.asarray(w.valh)[:count]
+        ts = np.asarray(w.ts)[:count]
+        records = {
+            int(key[i]): (int(gid[i]), int(ctr[i]), int(valh[i]), int(ts[i]))
+            for i in range(count)
+        }
+        return w, records
+
+    # ------------------------------------------------------------------
+    # payload GC (host dictionaries must track device alive masks)
+
+    def gc(self) -> None:
+        """Prune host payload/key dictionaries to currently-alive dots."""
+        with self._lock:
+            node = np.asarray(self.state.node)
+            ctr = np.asarray(self.state.ctr)
+            alive = np.asarray(self.state.alive)
+            gids = np.asarray(self.state.ctx_gid)[node]
+            live = {
+                (int(gids[i]), int(ctr[i])) for i in np.nonzero(alive)[0]
+            }
+            self._payloads = {d: p for d, p in self._payloads.items() if d in live}
+            keep_keys = {
+                np.asarray(self.state.key)[i].item() for i in np.nonzero(alive)[0]
+            }
+            self._key_terms = {h: t for h, t in self._key_terms.items() if h in keep_keys}
+
+    # ------------------------------------------------------------------
+    # threaded event loop (the reference's GenServer process analog)
+
+    def notify(self) -> None:
+        self._wake.set()
+
+    def process_pending(self) -> int:
+        """Deterministic drive: handle all queued messages now."""
+        n = 0
+        for msg in self.transport.drain(self.addr):
+            self.handle(msg)
+            n += 1
+        return n
+
+    def start(self) -> "Replica":
+        """Run the periodic anti-entropy loop in a background thread
+        (reference: ``send_after(self(), :sync, interval)``,
+        ``causal_crdt.ex:180-186``; first sync fires immediately, ``:46``)."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            next_sync = time.monotonic()  # immediate first sync
+            while not self._stop.is_set():
+                self.process_pending()
+                with self._lock:
+                    if self._pending:
+                        self._flush()
+                now = time.monotonic()
+                if now >= next_sync:
+                    self.sync_to_all()
+                    next_sync = now + self.sync_interval
+                self._wake.wait(timeout=max(0.0, min(next_sync - time.monotonic(), 0.05)))
+                self._wake.clear()
+
+        self._thread = threading.Thread(target=loop, name=f"crdt-{self.name}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Terminate: best-effort final sync (reference ``terminate/2``,
+        ``causal_crdt.ex:200-204``), then deregister (fires Down at
+        monitoring peers)."""
+        if self._thread is not None:
+            self._stop.set()
+            self._wake.set()
+            self._thread.join(timeout=5)
+            self._thread = None
+        try:
+            self.sync_to_all()
+        except Exception:  # best-effort, like the reference's TODO-marked path
+            logger.debug("final sync on terminate failed", exc_info=True)
+        self.transport.unregister(self.name)

@@ -1,0 +1,92 @@
+"""Persistence behaviour (reference ``DeltaCrdt.Storage``, ``storage.ex:15-16``).
+
+Contract: ``write(name, snapshot)`` / ``read(name) -> snapshot | None``.
+The snapshot carries everything needed for dot-counter continuity across
+a crash-restart (reference stores ``{node_id, sequence_number, crdt_state,
+merkle_map}``, ``causal_crdt.ex:242-250`` — note its typespec says 3-tuple,
+a doc/impl mismatch we fix rather than copy, SURVEY §2.1 #5).
+
+The reference writes through on **every** state change
+(``causal_crdt.ex:402-403``), which would serialise the device pipeline
+here; the replica driver therefore supports ``storage_mode="every_op"``
+(parity default: the crash-rehydrate tests of the reference hold exactly)
+and ``storage_mode="interval"`` (async snapshot cadence — the TPU-sane
+choice, SURVEY §5.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+import threading
+from typing import Any, Protocol
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Snapshot:
+    """Host-side image of a replica: device arrays + host dictionaries."""
+
+    node_id: int  # dot-namespace continuity across restarts
+    sequence_number: int  # number of applied mutation batches
+    arrays: dict[str, np.ndarray]  # DotStore columns + ctx tables
+    payloads: dict[tuple[int, int], tuple[Any, Any]]  # dot -> (key_term, value)
+    key_terms: dict[int, Any]  # key hash -> key term
+    last_ts: int  # clock continuity (LWW monotonicity)
+
+
+class Storage(Protocol):
+    def write(self, name: Any, snapshot: Snapshot) -> None: ...
+
+    def read(self, name: Any) -> Snapshot | None: ...
+
+
+class MemoryStorage:
+    """In-memory store (reference test fixture ``memory_storage.ex``) —
+    process-global so a restarted replica with the same name rehydrates."""
+
+    _store: dict[Any, bytes] = {}
+    _lock = threading.Lock()
+
+    def write(self, name, snapshot: Snapshot) -> None:
+        with self._lock:
+            MemoryStorage._store[name] = pickle.dumps(snapshot)
+
+    def read(self, name) -> Snapshot | None:
+        with self._lock:
+            blob = MemoryStorage._store.get(name)
+        return pickle.loads(blob) if blob is not None else None
+
+    @classmethod
+    def clear(cls) -> None:
+        with cls._lock:
+            cls._store.clear()
+
+
+class FileStorage:
+    """Directory-backed store: one pickle per replica name."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, name) -> str:
+        import hashlib
+
+        h = hashlib.blake2b(repr(name).encode(), digest_size=8).hexdigest()
+        return os.path.join(self.directory, f"crdt_{h}.pkl")
+
+    def write(self, name, snapshot: Snapshot) -> None:
+        tmp = self._path(name) + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(snapshot, f)
+        os.replace(tmp, self._path(name))
+
+    def read(self, name) -> Snapshot | None:
+        try:
+            with open(self._path(name), "rb") as f:
+                return pickle.load(f)
+        except FileNotFoundError:
+            return None

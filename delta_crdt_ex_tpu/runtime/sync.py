@@ -1,0 +1,123 @@
+"""Anti-entropy protocol: messages + the digest-tree level walk.
+
+This is the TPU-native redesign of the reference's two-phase Merkle
+anti-entropy (Almeida et al. Algorithm 2 shell, ``causal_crdt.ex:252-289``
++ ``:86-123``):
+
+- the originator A opens a sync with its tree root block; the peers then
+  **ping-pong bounded frontier blocks** — each message carries the
+  sender's digests for up to ``levels_per_round`` (default 8, exactly the
+  reference's ``prepare_partial_diff(mm, 8)`` fan) tree levels beneath the
+  currently-differing frontier, truncated to ``max_sync_size`` nodes
+  (reference ``truncate``, ``causal_crdt.ex:206-214``);
+- the receiver walks the block against its own tree (host numpy over
+  device-computed digests — control on host, bulk math on device), either
+  continuing the ping-pong, acking on equality (``{:ok, []}`` path,
+  ``causal_crdt.ex:101-102``), or arriving at differing leaf buckets;
+- differing buckets resolve to an entries transfer from the originator to
+  the peer (``get_diff`` / direct-slice paths, ``causal_crdt.ex:324-335``),
+  joined on device.
+
+Every message is bounded; truncated divergence heals over subsequent
+rounds (sync is idempotent). Data flows originator → peer only, matching
+the reference's unidirectional edges (``delta_crdt.ex:89-94``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Hashable
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class DiffMsg:
+    """Frontier block (the reference's ``%Diff{continuation: …}``)."""
+
+    originator: Hashable
+    frm: Hashable
+    to: Hashable
+    level: int  # tree level of the frontier (0 = root)
+    idx: np.ndarray  # int64[f] frontier node indices at `level`
+    blocks: list[np.ndarray]  # sender digests for levels level..level+j under idx
+
+
+@dataclasses.dataclass
+class GetDiffMsg:
+    """Peer asks the originator for its entries in differing buckets
+    (reference ``{:get_diff, diff, keys}``, ``causal_crdt.ex:112-123``)."""
+
+    originator: Hashable
+    frm: Hashable
+    to: Hashable
+    buckets: np.ndarray  # int64[b] differing leaf-bucket indices
+
+
+@dataclasses.dataclass
+class EntriesMsg:
+    """Entry slice transfer (reference ``{:diff, crdt_slice, keys}``)."""
+
+    originator: Hashable
+    frm: Hashable
+    to: Hashable
+    buckets: np.ndarray
+    arrays: dict[str, np.ndarray]  # DotStore slice columns + ctx tables
+    payloads: dict[tuple[int, int], tuple[Any, Any]]  # dot -> (key_term, value)
+
+
+@dataclasses.dataclass
+class AckMsg:
+    """Clears the originator's in-flight slot for `clear_addr`
+    (reference ``{:ack_diff, to}``, ``causal_crdt.ex:82-84,406-412``)."""
+
+    clear_addr: Hashable
+
+
+def make_blocks(
+    tree: list[np.ndarray], level: int, idx: np.ndarray, levels_per_round: int
+) -> list[np.ndarray]:
+    """Digest blocks for `levels_per_round` levels beneath frontier `idx`.
+
+    ``blocks[j]`` holds digests at ``level+j`` for all descendants of the
+    frontier, ordered (frontier position, subtree offset) — positions are
+    derivable, so only digest values travel.
+    """
+    depth = len(tree) - 1
+    end = min(level + levels_per_round, depth)
+    blocks = [tree[level][idx]]
+    for j in range(1, end - level + 1):
+        child_idx = (idx[:, None] * (1 << j) + np.arange(1 << j)[None, :]).reshape(-1)
+        blocks.append(tree[level + j][child_idx])
+    return blocks
+
+
+def walk(
+    tree: list[np.ndarray],
+    level: int,
+    idx: np.ndarray,
+    blocks: list[np.ndarray],
+    max_frontier: float,
+) -> tuple[int, np.ndarray]:
+    """Compare a received block against the local tree.
+
+    Returns ``(end_level, differing_idx)``: the deepest level the block
+    reaches and the still-differing node indices there (truncated per
+    level to ``max_frontier``, reference ``causal_crdt.ex:98,105``).
+    """
+    depth = len(tree) - 1
+    cur = np.asarray(idx, dtype=np.int64)
+    pos = np.arange(len(cur), dtype=np.int64)
+    diff = tree[level][cur] != blocks[0][pos]
+    cur, pos = cur[diff], pos[diff]
+    j = 0
+    while j + 1 < len(blocks) and len(cur):
+        j += 1
+        cur = np.stack([cur * 2, cur * 2 + 1], 1).reshape(-1)
+        pos = np.stack([pos * 2, pos * 2 + 1], 1).reshape(-1)
+        diff = tree[level + j][cur] != blocks[j][pos]
+        cur, pos = cur[diff], pos[diff]
+        if len(cur) > max_frontier:
+            cur, pos = cur[: int(max_frontier)], pos[: int(max_frontier)]
+    assert level + j <= depth
+    return level + j, cur

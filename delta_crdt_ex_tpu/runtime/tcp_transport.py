@@ -1,0 +1,318 @@
+"""Cross-host control-plane transport (reference: distributed Erlang).
+
+The reference gets cross-node distribution for free from the BEAM:
+location-transparent ``send/2`` to ``{name, node}`` over the full-mesh
+TCP of distributed Erlang (``causal_crdt_test.exs:68-78``; SURVEY §5.8).
+This module provides the equivalent for the TPU runtime: one
+:class:`TcpTransport` per host process, length-prefixed pickled frames
+over persistent TCP connections, with remote addresses written
+``(name, (host, port))`` — the ``{name, node}`` analog. Local names
+behave exactly like :class:`~delta_crdt_ex_tpu.runtime.transport.
+LocalTransport` addresses, so a replica's protocol code is transport-
+agnostic.
+
+Monitors over TCP are heartbeat-based: a background thread pings each
+monitored remote every ``heartbeat_interval``; a failed ping delivers
+:class:`~delta_crdt_ex_tpu.runtime.transport.Down` to the watcher — the
+``:DOWN`` analog (``causal_crdt.ex:127-145``). Like distributed Erlang
+inside a trusted cluster, frames are pickled Python objects: this
+transport assumes a trusted network (run it inside your pod/VPC), which
+is the same trust model the reference inherits from Erlang cookies.
+
+The *data plane* rides the same frames here (entry slices are numpy
+arrays, pickle handles them); moving slices device-to-device over
+ICI/DCN without the host hop is the :mod:`delta_crdt_ex_tpu.parallel`
+mesh path.
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue
+import socket
+import struct
+import threading
+from typing import Any, Hashable
+
+from delta_crdt_ex_tpu.runtime.transport import Down
+
+_LEN = struct.Struct(">I")
+
+# frame kinds
+_MSG = 0
+_PING = 1
+_PONG = 2
+
+
+def _send_frame(sock: socket.socket, kind: int, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload) + 1) + bytes([kind]) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class TcpTransport:
+    """Transport with the LocalTransport interface plus TCP remote sends.
+
+    Remote addresses: ``(name, (host, port))``. Everything else (bare
+    names) is local to this process.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, heartbeat_interval: float = 0.5):
+        self._lock = threading.Lock()
+        self._mailboxes: dict[Hashable, queue.Queue] = {}
+        self._owners: dict[Hashable, Any] = {}
+        self._monitors: dict[Hashable, set[Hashable]] = {}
+        self._conns: dict[tuple, socket.socket] = {}
+        self.heartbeat_interval = heartbeat_interval
+        self._stop = threading.Event()
+
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self.host, self.port = self._listener.getsockname()
+
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"tcp-accept-{self.port}", daemon=True
+        )
+        self._accept_thread.start()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, name=f"tcp-hb-{self.port}", daemon=True
+        )
+        self._hb_thread.start()
+
+    @property
+    def endpoint(self) -> tuple[str, int]:
+        return (self.host, self.port)
+
+    def remote_addr(self, name: Hashable) -> tuple:
+        """The ``{name, node}``-style address of a local name, for peers."""
+        return (name, self.endpoint)
+
+    canonical_addr = remote_addr  # replicas self-identify cross-node
+
+    # -- local registry (same contract as LocalTransport) -----------------
+
+    def register(self, addr: Hashable, owner: Any) -> None:
+        with self._lock:
+            if addr in self._owners:
+                raise ValueError(f"address already registered: {addr!r}")
+            self._mailboxes[addr] = queue.Queue()
+            self._owners[addr] = owner
+
+    def unregister(self, addr: Hashable) -> None:
+        addr = self._local_name(addr)
+        with self._lock:
+            self._mailboxes.pop(addr, None)
+            self._owners.pop(addr, None)
+            watchers = self._monitors.pop(addr, set())
+        for w in watchers:
+            self.send(w, Down(addr))
+
+    def _is_remote(self, addr) -> bool:
+        return (
+            isinstance(addr, tuple)
+            and len(addr) == 2
+            and isinstance(addr[1], tuple)
+            and len(addr[1]) == 2
+            and addr[1] != self.endpoint
+        )
+
+    def alive(self, addr: Hashable) -> bool:
+        if self._is_remote(addr):
+            return self._ping(addr)
+        addr = self._local_name(addr)
+        with self._lock:
+            return addr in self._owners
+
+    def _local_name(self, addr):
+        # a remote-style address pointing at ourselves resolves locally
+        if isinstance(addr, tuple) and len(addr) == 2 and addr[1] == self.endpoint:
+            return addr[0]
+        return addr
+
+    # -- sending -----------------------------------------------------------
+
+    def send(self, addr: Hashable, msg: Any) -> bool:
+        if self._is_remote(addr):
+            return self._send_remote(addr, (_MSG, addr[0], msg))
+        name = self._local_name(addr)
+        with self._lock:
+            mb = self._mailboxes.get(name)
+            owner = self._owners.get(name)
+        if mb is None:
+            return False
+        mb.put(msg)
+        notify = getattr(owner, "notify", None)
+        if notify is not None:
+            notify()
+        return True
+
+    def _connect(self, endpoint: tuple) -> socket.socket | None:
+        with self._lock:
+            sock = self._conns.get(endpoint)
+        if sock is not None:
+            return sock
+        try:
+            sock = socket.create_connection(endpoint, timeout=2.0)
+            sock.settimeout(5.0)
+        except OSError:
+            return None
+        with self._lock:
+            self._conns[endpoint] = sock
+        return sock
+
+    def _drop_conn(self, endpoint: tuple) -> None:
+        with self._lock:
+            sock = self._conns.pop(endpoint, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _send_remote(self, addr: tuple, frame: tuple) -> bool:
+        _name, endpoint = addr
+        payload = pickle.dumps(frame[1:], protocol=4)
+        for _attempt in range(2):  # one reconnect on a stale pooled conn
+            sock = self._connect(endpoint)
+            if sock is None:
+                return False
+            try:
+                with self._lock:
+                    _send_frame(sock, frame[0], payload)
+                return True
+            except OSError:
+                self._drop_conn(endpoint)
+        return False
+
+    def _ping(self, addr: tuple) -> bool:
+        # connection-level liveness: a fresh short-lived connection probes
+        # the remote listener (the monitored name is checked by heartbeat
+        # MSG delivery failures instead; a dead listener is the BEAM
+        # "node down" analog)
+        try:
+            with socket.create_connection(addr[1], timeout=1.0) as s:
+                _send_frame(s, _PING, b"")
+                s.settimeout(2.0)
+                hdr = _recv_exact(s, 4)
+                if hdr is None:
+                    return False
+                n = _LEN.unpack(hdr)[0]
+                body = _recv_exact(s, n)
+                return body is not None and body[0] == _PONG
+        except OSError:
+            return False
+
+    # -- monitors ----------------------------------------------------------
+
+    def monitor(self, watcher: Hashable, target: Hashable) -> bool:
+        if not self.alive(target):
+            return False
+        key = target if not isinstance(target, list) else tuple(target)
+        with self._lock:
+            self._monitors.setdefault(key, set()).add(watcher)
+        return True
+
+    def demonitor(self, watcher: Hashable, target: Hashable) -> None:
+        with self._lock:
+            self._monitors.get(target, set()).discard(watcher)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval):
+            with self._lock:
+                remote_targets = [t for t in self._monitors if self._is_remote(t)]
+            for t in remote_targets:
+                if not self._ping(t):
+                    with self._lock:
+                        watchers = self._monitors.pop(t, set())
+                    for w in watchers:
+                        self.send(w, Down(t))
+
+    # -- receiving ---------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _peer = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._stop.is_set():
+                hdr = _recv_exact(conn, 4)
+                if hdr is None:
+                    return
+                n = _LEN.unpack(hdr)[0]
+                body = _recv_exact(conn, n)
+                if body is None:
+                    return
+                kind, payload = body[0], body[1:]
+                if kind == _PING:
+                    try:
+                        _send_frame(conn, _PONG, b"")
+                    except OSError:
+                        return
+                elif kind == _MSG:
+                    name, msg = pickle.loads(payload)
+                    self.send(name, msg)
+
+    # -- deterministic driving (parity with LocalTransport) ----------------
+
+    def drain(self, addr: Hashable) -> list:
+        with self._lock:
+            mb = self._mailboxes.get(self._local_name(addr))
+        out = []
+        if mb is None:
+            return out
+        while True:
+            try:
+                out.append(mb.get_nowait())
+            except queue.Empty:
+                return out
+
+    def pump(self, max_rounds: int = 10_000) -> int:
+        delivered = 0
+        for _ in range(max_rounds):
+            progressed = False
+            with self._lock:
+                addrs = list(self._owners)
+            for addr in addrs:
+                with self._lock:
+                    owner = self._owners.get(addr)
+                if owner is None:
+                    continue
+                for msg in self.drain(addr):
+                    owner.handle(msg)
+                    delivered += 1
+                    progressed = True
+            if not progressed:
+                return delivered
+        raise RuntimeError("transport did not quiesce")
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass

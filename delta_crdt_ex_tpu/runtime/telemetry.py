@@ -1,0 +1,39 @@
+"""Telemetry — the observability surface (reference: one ``:telemetry`` event).
+
+The reference fires ``[:delta_crdt, :sync, :done]`` with
+``%{keys_updated_count: n}`` and ``%{name: name}`` on **every** merge —
+local ops and remote deltas alike (``causal_crdt.ex:396-398``). Same
+contract here, plus a few TPU-runtime events (kernel timings, capacity
+growth) under the same attach/execute API.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Callable
+
+SYNC_DONE = ("delta_crdt", "sync", "done")  # measurements: keys_updated_count
+CAPACITY_GROWN = ("delta_crdt", "capacity", "grown")  # measurements: capacity, replica_capacity
+SYNC_ROUND = ("delta_crdt", "sync", "round")  # measurements: duration_s, buckets, entries
+
+_lock = threading.Lock()
+_handlers: dict[tuple, list[Callable]] = defaultdict(list)
+
+
+def attach(event: tuple, handler: Callable[[tuple, dict, dict], None]) -> None:
+    with _lock:
+        _handlers[event].append(handler)
+
+
+def detach(event: tuple, handler: Callable) -> None:
+    with _lock:
+        if handler in _handlers.get(event, []):
+            _handlers[event].remove(handler)
+
+
+def execute(event: tuple, measurements: dict, metadata: dict) -> None:
+    with _lock:
+        handlers = list(_handlers.get(event, []))
+    for h in handlers:
+        h(event, measurements, metadata)

@@ -1,0 +1,113 @@
+"""Pure-Python executable specification of the AWLWWMap semantics.
+
+A from-scratch Python mirror of the reference lattice semantics
+(``aw_lww_map.ex``) over the same structures the paper uses — nested
+dot-store ``{key: {(value, ts): set(dots)}}`` plus a causal context in
+dual representation (compressed ``{node: max}`` state form / explicit dot
+set delta form, ``aw_lww_map.ex:13-28``).
+
+Used two ways:
+
+- as the **oracle** in property tests (the reference's model-vs-lattice
+  pattern, ``aw_lww_map_test.exs:51-86``);
+- as the **baseline stand-in** in ``bench.py``: Elixir/BEAM is not
+  available in this image and the reference publishes no numbers
+  (BASELINE.md), so per-element host-language dot-store math — the same
+  asymptotic work the BEAM implementation does — is the measured
+  comparison point.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Hashable
+
+
+def _ctx_member(ctx, dot) -> bool:
+    """Polymorphic dot membership (``Dots.member?``, ``aw_lww_map.ex:67-73``)."""
+    if isinstance(ctx, set):
+        return dot in ctx
+    node, ctr = dot
+    return ctx.get(node, 0) >= ctr
+
+
+def _ctx_union(a, b):
+    """Context union (``Dots.union``, ``aw_lww_map.ex:39-52``)."""
+    if isinstance(a, set) and isinstance(b, set):
+        return a | b
+    if isinstance(a, set):
+        a, b = b, a
+    out = dict(a)
+    for node, ctr in b.items() if isinstance(b, dict) else b:
+        if out.get(node, 0) < ctr:
+            out[node] = ctr
+    return out
+
+
+class PyAWLWWMap:
+    """One lattice state/delta. ``dots`` is the causal context (dict =
+    compressed state form, set = delta form); ``value`` the dot store."""
+
+    def __init__(self, dots=None, value=None, compressed: bool = True):
+        self.dots = dots if dots is not None else ({} if compressed else set())
+        self.value: dict[Hashable, dict[tuple, set]] = value if value is not None else {}
+
+    # -- mutators (return deltas, reference aw_lww_map.ex:99-150) --------
+
+    def add(self, key, val, node, ts) -> "PyAWLWWMap":
+        observed = set()
+        for dotset in self.value.get(key, {}).values():
+            observed |= dotset
+        if isinstance(self.dots, dict):
+            next_ctr = self.dots.get(node, 0) + 1
+        else:  # delta form ("inefficient next_dot", aw_lww_map.ex:30-33)
+            next_ctr = max((c for n, c in self.dots if n == node), default=0) + 1
+        dot = (node, next_ctr)
+        return PyAWLWWMap(dots=observed | {dot}, value={key: {(val, ts): {dot}}})
+
+    def remove(self, key) -> "PyAWLWWMap":
+        observed = set()
+        for dotset in self.value.get(key, {}).values():
+            observed |= dotset
+        return PyAWLWWMap(dots=observed, value={})
+
+    def clear(self) -> "PyAWLWWMap":
+        observed = set()
+        for sub in self.value.values():
+            for dotset in sub.values():
+                observed |= dotset
+        return PyAWLWWMap(dots=observed, value={})
+
+    # -- lattice join (reference aw_lww_map.ex:153-209) -------------------
+
+    def join(self, other: "PyAWLWWMap", keys) -> "PyAWLWWMap":
+        new_dots = _ctx_union(self.dots, other.dots)
+        new_value = {k: v for k, v in self.value.items() if k not in keys}
+        for k, v in other.value.items():
+            if k not in keys:
+                new_value[k] = v
+        for key in keys:
+            s1 = self.value.get(key, {})
+            s2 = other.value.get(key, {})
+            merged: dict[tuple, set] = {}
+            for pair in set(s1) | set(s2):
+                d1 = s1.get(pair, set())
+                d2 = s2.get(pair, set())
+                keep = (d1 & d2)
+                keep |= {d for d in d1 if not _ctx_member(other.dots, d)}
+                keep |= {d for d in d2 if not _ctx_member(self.dots, d)}
+                if keep:
+                    merged[pair] = keep
+            if merged:
+                new_value[key] = merged
+            elif key in new_value:
+                del new_value[key]
+        return PyAWLWWMap(dots=new_dots, value=new_value)
+
+    # -- reads (reference aw_lww_map.ex:211-224) --------------------------
+
+    def read(self) -> dict[Hashable, Any]:
+        out = {}
+        for key, pairs in self.value.items():
+            (val, _ts) = max(pairs, key=lambda p: p[1])
+            out[key] = val
+        return out

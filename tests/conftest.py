@@ -1,0 +1,49 @@
+"""Test harness config.
+
+Tests run on CPU with 8 virtual devices so multi-chip sharding paths
+(`parallel/`) are exercised without TPU hardware; the env vars must be in
+place before JAX initialises its backends.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the ambient env pins the TPU plugin
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from delta_crdt_ex_tpu.runtime.clock import LogicalClock  # noqa: E402
+from delta_crdt_ex_tpu.runtime.storage import MemoryStorage  # noqa: E402
+from delta_crdt_ex_tpu.runtime.transport import LocalTransport  # noqa: E402
+
+
+@pytest.fixture
+def transport():
+    return LocalTransport()
+
+
+@pytest.fixture(autouse=True)
+def _clean_memory_storage():
+    yield
+    MemoryStorage.clear()
+
+
+@pytest.fixture
+def shared_clock():
+    """One logical clock shared by all replicas in a test: global LWW order
+    is then deterministic (ts strictly increases across the whole test)."""
+    return LogicalClock()
+
+
+def converge(transport, replicas, rounds: int = 6):
+    """Deterministic convergence driver: repeated full sync rounds with
+    message pumping — the "sync now / quiesce" hook SURVEY §4 calls for
+    instead of the reference's flaky ``Process.sleep`` waits."""
+    for _ in range(rounds):
+        for r in replicas:
+            r.sync_to_all()
+        transport.pump()

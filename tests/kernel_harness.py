@@ -1,0 +1,84 @@
+"""Thin host harness driving the lattice kernels directly (no runtime).
+
+Keys and values are small ints carried verbatim in the device columns
+(key = uint64 id, value = the ``valh`` column), so lattice tests compare
+kernel output against the pure-Python spec without any payload plumbing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from delta_crdt_ex_tpu.models.aw_lww_map import AWLWWMap
+from delta_crdt_ex_tpu.models.state import DotStore
+from delta_crdt_ex_tpu.ops.apply import OP_ADD, OP_CLEAR, OP_REMOVE
+
+
+class KernelMap:
+    def __init__(self, gid: int, capacity: int = 64, rcap: int = 8, num_buckets: int = 64):
+        self.gid = gid
+        state = DotStore.new(capacity, rcap, num_buckets)
+        self.state = dataclasses.replace(
+            state, ctx_gid=state.ctx_gid.at[0].set(jnp.uint64(gid))
+        )
+        self.slot = 0
+
+    def _apply(self, op_rows):
+        k = 8
+        while k < len(op_rows):
+            k *= 2
+        op = np.zeros(k, np.int32)
+        key = np.zeros(k, np.uint64)
+        valh = np.zeros(k, np.uint32)
+        ts = np.zeros(k, np.int64)
+        for i, (o, kk, v, t) in enumerate(op_rows):
+            op[i], key[i], valh[i], ts[i] = o, kk, v, t
+        while True:
+            res = AWLWWMap.apply_batch(
+                self.state, jnp.int32(self.slot), *map(jnp.asarray, (op, key, valh, ts))
+            )
+            if bool(res.ok):
+                self.state = res.state
+                return res
+            self.state = self.state.grow(self.state.capacity * 2)
+
+    def add(self, key: int, val: int, ts: int):
+        return self._apply([(OP_ADD, key, val, ts)])
+
+    def remove(self, key: int, ts: int = 0):
+        return self._apply([(OP_REMOVE, key, 0, ts)])
+
+    def clear(self, ts: int = 0):
+        return self._apply([(OP_CLEAR, 0, 0, ts)])
+
+    def batch(self, rows):
+        return self._apply(rows)
+
+    def join_from(self, other: "KernelMap"):
+        while True:
+            res = AWLWWMap.join(self.state, other.state)
+            if bool(res.ok):
+                self.state = res.state
+                return res
+            self.state = self.state.grow(
+                self.state.capacity * 2, self.state.replica_capacity * 2
+            )
+
+    def read(self) -> dict[int, int]:
+        w = AWLWWMap.winner_slice(self.state, None, out_size=self.state.capacity)
+        count = int(w.count)
+        keys = np.asarray(w.key)[:count]
+        vals = np.asarray(w.valh)[:count]
+        return {int(keys[i]): int(vals[i]) for i in range(count)}
+
+    def ctx(self) -> dict[int, int]:
+        """Global compressed-context view (reference ``Dots.compress``)."""
+        gids = np.asarray(self.state.ctx_gid)
+        maxs = np.asarray(self.state.global_ctx())
+        return {int(g): int(m) for g, m in zip(gids, maxs) if g != 0 and m != 0}
+
+    def alive_count(self) -> int:
+        return int(self.state.num_alive())

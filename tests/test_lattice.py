@@ -1,0 +1,209 @@
+"""Lattice kernel tests vs the pure-Python spec and a plain-dict model.
+
+Ports the reference's lattice suite (``test/aw_lww_map_test.exs``,
+``test/aw_lww_map_property_test.exs``): unit cases plus the oracle
+pattern — arbitrary add/remove sequences must read back like a plain
+dict (SURVEY §4).
+"""
+
+import random
+
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from delta_crdt_ex_tpu.utils.pyref import PyAWLWWMap
+from tests.kernel_harness import KernelMap
+
+A_GID, B_GID = 11, 22
+
+
+def test_can_add_and_read_a_value():
+    m = KernelMap(A_GID)
+    m.add(1, 2, ts=1)
+    assert m.read() == {1: 2}
+
+
+def test_can_join_two_adds():
+    a = KernelMap(A_GID)
+    a.add(1, 2, ts=1)
+    b = KernelMap(B_GID)
+    b.add(2, 2, ts=2)
+    a.join_from(b)
+    assert a.read() == {1: 2, 2: 2}
+
+
+def test_can_remove_elements():
+    m = KernelMap(A_GID)
+    m.add(1, 2, ts=1)
+    m.remove(1)
+    assert m.read() == {}
+
+
+def test_remove_only_kills_observed_dots_add_wins():
+    # concurrent add at B vs remove at A: the unobserved add survives
+    a = KernelMap(A_GID)
+    a.add(1, 2, ts=1)
+    b = KernelMap(B_GID)
+    b.join_from(a)
+    b.add(1, 99, ts=2)  # B's new dot, unseen by A
+    a.remove(1)  # kills only A-observed dots
+    b.join_from(a)
+    assert b.read() == {1: 99}
+
+
+def test_can_resolve_conflicts_lww():
+    m = KernelMap(A_GID)
+    m.add(1, 2, ts=1)
+    m.add(1, 3, ts=2)
+    assert m.read() == {1: 3}
+    # the losing value's entry is gone, not just shadowed
+    assert m.alive_count() == 1
+
+
+def test_context_stays_compressed():
+    # reference "can compute actual dots present": state context is the
+    # compressed per-node max, not a growing dot list
+    m = KernelMap(A_GID)
+    m.add(1, 2, ts=1)
+    m.add(1, 3, ts=2)
+    assert m.ctx() == {A_GID: 2}
+    assert m.alive_count() == 1
+
+
+def test_clear_removes_everything():
+    m = KernelMap(A_GID)
+    m.add(1, 2, ts=1)
+    m.add(2, 3, ts=2)
+    m.clear()
+    assert m.read() == {}
+    # cleared dots stay observed: rejoining an old copy must not resurrect
+    old = KernelMap(B_GID)
+    old.add(3, 4, ts=3)
+    m.join_from(old)
+    assert m.read() == {3: 4}
+
+
+def test_batch_sequential_semantics():
+    from delta_crdt_ex_tpu.ops.apply import OP_ADD, OP_CLEAR, OP_REMOVE
+
+    m = KernelMap(A_GID)
+    m.batch(
+        [
+            (OP_ADD, 1, 10, 1),
+            (OP_ADD, 2, 20, 2),
+            (OP_ADD, 1, 11, 3),  # shadows the first add
+            (OP_REMOVE, 2, 0, 4),
+            (OP_ADD, 3, 30, 5),
+        ]
+    )
+    assert m.read() == {1: 11, 3: 30}
+    m.batch([(OP_ADD, 4, 40, 6), (OP_CLEAR, 0, 0, 7), (OP_ADD, 5, 50, 8)])
+    assert m.read() == {5: 50}
+
+
+def test_join_is_idempotent_and_commutative():
+    a = KernelMap(A_GID)
+    a.add(1, 1, ts=1)
+    a.add(2, 2, ts=2)
+    b = KernelMap(B_GID)
+    b.add(2, 22, ts=3)
+    b.add(3, 3, ts=4)
+
+    ab = KernelMap(A_GID)
+    ab.add(1, 1, ts=1)
+    ab.add(2, 2, ts=2)
+    ab.join_from(b)
+    ab.join_from(b)  # idempotent
+    ba = KernelMap(B_GID)
+    ba.add(2, 22, ts=3)
+    ba.add(3, 3, ts=4)
+    ba.join_from(a)
+    assert ab.read() == ba.read() == {1: 1, 2: 22, 3: 3}
+
+
+ops_strategy = st.lists(
+    st.tuples(
+        st.sampled_from(["add", "remove"]),
+        st.integers(min_value=1, max_value=8),  # key
+        st.integers(min_value=0, max_value=100),  # value
+    ),
+    max_size=40,
+)
+
+
+@settings(max_examples=60, deadline=None)
+@given(ops_strategy)
+def test_property_single_replica_matches_dict_model(ops):
+    """Reference property: arbitrary add/remove sequence == plain Map
+    (``aw_lww_map_test.exs:51-86``)."""
+    m = KernelMap(A_GID, capacity=128)
+    model = {}
+    spec = PyAWLWWMap()
+    for i, (op, key, val) in enumerate(ops):
+        ts = i + 1
+        if op == "add":
+            m.add(key, val, ts=ts)
+            delta = spec.add(key, val, A_GID, ts)
+            model[key] = val
+        else:
+            m.remove(key, ts=ts)
+            delta = spec.remove(key)
+            model.pop(key, None)
+        spec = spec.join(delta, [key])
+    assert m.read() == model
+    assert spec.read() == model
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    st.lists(
+        st.tuples(
+            st.integers(min_value=0, max_value=2),  # replica
+            st.sampled_from(["add", "remove", "sync"]),
+            st.integers(min_value=1, max_value=6),  # key / sync target
+            st.integers(min_value=0, max_value=50),  # value
+        ),
+        max_size=30,
+    ),
+    st.randoms(use_true_random=False),
+)
+def test_property_multi_replica_convergence_vs_spec(script, rnd):
+    """Random concurrent ops + random pairwise joins on 3 replicas: the
+    kernel lattice and the Python spec stay in lockstep, and full pairwise
+    sync converges everyone to the same read."""
+    gids = [101, 202, 303]
+    ks = [KernelMap(g, capacity=128) for g in gids]
+    specs = [PyAWLWWMap() for _ in gids]
+    ts = 0
+    for who, op, key, val in script:
+        ts += 1
+        if op == "add":
+            ks[who].add(key, val, ts=ts)
+            specs[who] = specs[who].join(specs[who].add(key, val, gids[who], ts), [key])
+        elif op == "remove":
+            ks[who].remove(key, ts=ts)
+            specs[who] = specs[who].join(specs[who].remove(key), [key])
+        else:
+            other = key % 3
+            if other != who:
+                ks[who].join_from(ks[other])
+                all_keys = set(specs[who].value) | set(specs[other].value)
+                specs[who] = specs[who].join(
+                    PyAWLWWMap(dots=specs[other].dots, value=specs[other].value),
+                    list(all_keys),
+                )
+        assert ks[who].read() == specs[who].read()
+
+    # full mesh sync until converged
+    for _ in range(3):
+        for i in range(3):
+            for j in range(3):
+                if i != j:
+                    ks[i].join_from(ks[j])
+                    all_keys = set(specs[i].value) | set(specs[j].value)
+                    specs[i] = specs[i].join(
+                        PyAWLWWMap(dots=specs[j].dots, value=specs[j].value),
+                        list(all_keys),
+                    )
+    reads = [k.read() for k in ks]
+    assert reads[0] == reads[1] == reads[2] == specs[0].read()

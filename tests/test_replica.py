@@ -1,0 +1,238 @@
+"""Replica runtime tests — ports of ``test/causal_crdt_test.exs``.
+
+Multi-replica topology lives in one process wired through a
+LocalTransport (the reference's single-BEAM-node pattern, SURVEY §4),
+driven deterministically via ``sync_to_all`` + ``pump`` instead of
+``Process.sleep``.
+"""
+
+import pytest
+
+from delta_crdt_ex_tpu import AWLWWMap, MemoryStorage
+from delta_crdt_ex_tpu.api import start_link
+from delta_crdt_ex_tpu.runtime.clock import LogicalClock
+from tests.conftest import converge
+
+
+def mk(transport, clock, **opts):
+    opts.setdefault("capacity", 64)
+    opts.setdefault("tree_depth", 6)
+    return start_link(
+        AWLWWMap, threaded=False, transport=transport, clock=clock, **opts
+    )
+
+
+@pytest.fixture
+def trio(transport, shared_clock):
+    cs = [mk(transport, shared_clock) for _ in range(3)]
+    for c in cs:
+        c.set_neighbours(cs)  # includes self, like the reference fixture
+    transport.pump()
+    return cs
+
+
+def test_basic_case(trio):
+    c1, c2, c3 = trio
+    c1.mutate_async("add", ["Derek", "Kraan"])
+    c1.mutate_async("add", ["Tonci", "Galic"])
+    assert c1.read() == {"Derek": "Kraan", "Tonci": "Galic"}
+
+
+def test_conflicting_updates_resolve(trio, transport):
+    c1, c2, c3 = trio
+    c1.mutate_async("add", ["Derek", "one_wins"])
+    c1.mutate_async("add", ["Derek", "two_wins"])
+    c1.mutate_async("add", ["Derek", "three_wins"])
+    converge(transport, trio)
+    for c in trio:
+        assert c.read() == {"Derek": "three_wins"}
+
+
+def test_add_wins(trio, transport):
+    c1, c2, c3 = trio
+    c1.mutate("add", ["Derek", "add_wins"])
+    c2.mutate("remove", ["Derek"])  # concurrent: c2 hasn't observed c1's dot
+    converge(transport, trio)
+    assert c1.read() == {"Derek": "add_wins"}
+    assert c2.read() == {"Derek": "add_wins"}
+
+
+def test_can_remove(trio, transport):
+    c1, c2, _ = trio
+    c1.mutate("add", ["Derek", "add_wins"])
+    converge(transport, trio)
+    assert c2.read() == {"Derek": "add_wins"}
+    c1.mutate("remove", ["Derek"])
+    converge(transport, trio)
+    assert c1.read() == {}
+    assert c2.read() == {}
+
+
+def test_sync_is_directional(transport, shared_clock):
+    c1 = mk(transport, shared_clock)
+    c2 = mk(transport, shared_clock)
+    c1.set_neighbours([c2])
+    c1.mutate("add", ["Derek", "Kraan"])
+    c2.mutate("add", ["Tonci", "Galic"])
+    converge(transport, [c1, c2])
+    assert c1.read() == {"Derek": "Kraan"}
+    assert c2.read() == {"Derek": "Kraan", "Tonci": "Galic"}
+
+
+def test_sync_to_neighbours_by_name(transport, shared_clock):
+    c1 = mk(transport, shared_clock, name="neighbour_name_1")
+    c2 = mk(transport, shared_clock, name="neighbour_name_2")
+    c1.set_neighbours(["neighbour_name_2"])
+    c2.set_neighbours(["neighbour_name_1"])
+    c1.mutate("add", ["Derek", "Kraan"])
+    c2.mutate("add", ["Tonci", "Galic"])
+    converge(transport, [c1, c2])
+    assert c1.read() == {"Derek": "Kraan", "Tonci": "Galic"}
+    assert c2.read() == {"Derek": "Kraan", "Tonci": "Galic"}
+
+
+def test_storage_backend_stores_and_retrieves(transport, shared_clock):
+    c = mk(transport, shared_clock, storage_module=MemoryStorage(), name="storage_test")
+    c.mutate("add", ["Derek", "Kraan"])
+    assert c.read() == {"Derek": "Kraan"}
+
+
+def test_storage_rehydrates_after_crash(transport, shared_clock):
+    c = mk(transport, shared_clock, storage_module=MemoryStorage(), name="storage_test")
+    c.mutate("add", ["Derek", "Kraan"])
+    node_id = c.node_id
+    c.transport.unregister(c.addr)  # simulated crash: no terminate sync
+
+    c2 = mk(transport, shared_clock, storage_module=MemoryStorage(), name="storage_test")
+    assert c2.read() == {"Derek": "Kraan"}
+    assert c2.node_id == node_id  # dot-namespace continuity (causal_crdt.ex:225-230)
+
+
+def test_syncs_after_adding_neighbour(transport, shared_clock):
+    c1 = mk(transport, shared_clock)
+    c2 = mk(transport, shared_clock)
+    c1.mutate("add", ["CRDT1", "represent"])
+    c2.mutate("add", ["CRDT2", "also here"])
+    c1.set_neighbours([c2])  # triggers an immediate sync round
+    transport.pump()
+    assert c2.read() == {"CRDT1": "represent", "CRDT2": "also here"}
+    assert c1.read() == {"CRDT1": "represent"}  # directional
+
+
+def test_sync_after_network_partition(transport, shared_clock):
+    c1 = mk(transport, shared_clock)
+    c2 = mk(transport, shared_clock)
+    c1.set_neighbours([c2])
+    c2.set_neighbours([c1])
+    c1.mutate("add", ["CRDT1", "represent"])
+    c2.mutate("add", ["CRDT2", "also here"])
+    converge(transport, [c1, c2])
+    assert c1.read() == {"CRDT1": "represent", "CRDT2": "also here"}
+
+    # partition
+    c1.set_neighbours([])
+    c2.set_neighbours([])
+    transport.pump()
+    c1.mutate("add", ["CRDTa", "only present in 1"])
+    c1.mutate("add", ["CRDTb", "only present in 1"])
+    c1.mutate("remove", ["CRDT1"])
+    converge(transport, [c1, c2])
+    assert "CRDTa" in c1.read()
+    assert "CRDTa" not in c2.read()
+    assert "CRDT1" in c2.read()  # removal can't propagate yet
+
+    # heal
+    c1.set_neighbours([c2])
+    c2.set_neighbours([c1])
+    converge(transport, [c1, c2])
+    for c in (c1, c2):
+        r = c.read()
+        assert "CRDTa" in r and "CRDTb" in r
+        assert "CRDT1" not in r
+        assert r["CRDT2"] == "also here"
+
+
+def test_syncing_when_values_happen_to_be_the_same(transport, shared_clock):
+    c1 = mk(transport, shared_clock)
+    c2 = mk(transport, shared_clock)
+    c1.set_neighbours([c2])
+    c2.set_neighbours([c1])
+    c1.mutate("add", ["key", "value"])
+    c2.mutate("add", ["key", "value"])  # same value, different dots
+    converge(transport, [c1, c2])
+    c1.mutate("remove", ["key"])  # must kill BOTH dots everywhere
+    converge(transport, [c1, c2])
+    assert "key" not in c1.read()
+    assert "key" not in c2.read()
+
+
+def test_down_cleans_monitor_and_outstanding(transport, shared_clock):
+    c1 = mk(transport, shared_clock)
+    c2 = mk(transport, shared_clock)
+    c1.set_neighbours([c2])
+    converge(transport, [c1, c2])
+    assert c2.addr in c1._monitors
+    c2.transport.unregister(c2.addr)  # dies
+    transport.pump()
+    assert c2.addr not in c1._monitors
+    assert c2.addr not in c1._outstanding
+    c1.sync_to_all()  # must not blow up on the dead neighbour
+    transport.pump()
+
+
+def test_max_sync_size_validation(transport):
+    with pytest.raises(ValueError):
+        mk(transport, LogicalClock(), max_sync_size=0)
+    with pytest.raises(ValueError):
+        mk(transport, LogicalClock(), max_sync_size="bogus")
+    c = mk(transport, LogicalClock(), max_sync_size="infinite")
+    assert c.max_sync_size == float("inf")
+
+
+def test_max_sync_size_bounds_but_converges(transport, shared_clock):
+    c1 = mk(transport, shared_clock, max_sync_size=4, capacity=256, tree_depth=6)
+    c2 = mk(transport, shared_clock, max_sync_size=4, capacity=256, tree_depth=6)
+    c1.set_neighbours([c2])
+    for i in range(40):
+        c1.mutate_async("add", [f"k{i}", i])
+    converge(transport, [c1, c2], rounds=40)
+    assert c2.read() == {f"k{i}": i for i in range(40)}
+
+
+def test_arbitrary_term_keys_and_values(transport, shared_clock):
+    c1 = mk(transport, shared_clock)
+    c2 = mk(transport, shared_clock)
+    c1.set_neighbours([c2])
+    key = (1, "tuple", frozenset({3, 4}))
+    c1.mutate("add", [key, {"nested": [1, 2, {"deep": None}]}])
+    c1.mutate("add", [b"bytes-key", 3.14159])
+    converge(transport, [c1, c2])
+    got = c2.read()
+    assert got[key] == {"nested": [1, 2, {"deep": None}]}
+    assert got[b"bytes-key"] == 3.14159
+
+
+def test_threaded_mode_doctest_flow(transport, shared_clock):
+    """The README/doctest happy path with real background sync threads
+    (reference doctest, delta_crdt.ex:17-28)."""
+    import time
+
+    c1 = start_link(AWLWWMap, transport=transport, clock=shared_clock,
+                    sync_interval=0.003, capacity=64, tree_depth=6)
+    c2 = start_link(AWLWWMap, transport=transport, clock=shared_clock,
+                    sync_interval=0.003, capacity=64, tree_depth=6)
+    try:
+        # threaded mode: each replica's own loop drains its mailbox
+        c1.set_neighbours([c2])
+        c2.set_neighbours([c1])
+        assert c1.read() == {}
+        c1.mutate("add", ["CRDT", "is magic!"])
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if c2.read() == {"CRDT": "is magic!"}:
+                break
+            time.sleep(0.01)
+        assert c2.read() == {"CRDT": "is magic!"}
+    finally:
+        c1.stop()
+        c2.stop()

@@ -1,0 +1,81 @@
+"""Cross-"node" sync over the TCP control plane.
+
+Two TcpTransports in one process model two hosts (the reference's
+``{name, node}`` addressing, ``causal_crdt_test.exs:68-78``): replicas on
+different transports sync through real sockets.
+"""
+
+import time
+
+import pytest
+
+from delta_crdt_ex_tpu import AWLWWMap
+from delta_crdt_ex_tpu.api import start_link
+from delta_crdt_ex_tpu.runtime.clock import LogicalClock
+from delta_crdt_ex_tpu.runtime.tcp_transport import TcpTransport
+
+
+@pytest.fixture
+def two_nodes():
+    ta = TcpTransport()
+    tb = TcpTransport()
+    yield ta, tb
+    ta.close()
+    tb.close()
+
+
+def pump_both(ta, tb, rounds=10):
+    for _ in range(rounds):
+        ta.pump()
+        tb.pump()
+        time.sleep(0.01)  # socket delivery threads need a beat
+
+
+def test_cross_node_bidirectional_sync(two_nodes, shared_clock):
+    ta, tb = two_nodes
+    a = start_link(AWLWWMap, threaded=False, transport=ta, clock=shared_clock,
+                   name="a", capacity=64, tree_depth=6)
+    b = start_link(AWLWWMap, threaded=False, transport=tb, clock=shared_clock,
+                   name="b", capacity=64, tree_depth=6)
+    # {name, node}-style remote addresses
+    a.set_neighbours([tb.remote_addr("b")])
+    b.set_neighbours([ta.remote_addr("a")])
+    a.mutate("add", ["from_a", 1])
+    b.mutate("add", ["from_b", 2])
+
+    deadline = time.monotonic() + 20
+    want = {"from_a": 1, "from_b": 2}
+    while time.monotonic() < deadline:
+        a.sync_to_all()
+        b.sync_to_all()
+        pump_both(ta, tb, rounds=5)
+        if a.read() == want and b.read() == want:
+            break
+    assert a.read() == want
+    assert b.read() == want
+
+
+def test_remote_liveness_ping(two_nodes):
+    ta, tb = two_nodes
+    assert ta.alive(("anything", tb.endpoint))
+    tb.close()
+    time.sleep(0.05)
+    assert not ta.alive(("anything", tb.endpoint))
+
+
+def test_down_delivered_for_dead_remote_node(two_nodes, shared_clock):
+    ta, tb = two_nodes
+    ta.heartbeat_interval = 0.05
+    a = start_link(AWLWWMap, threaded=False, transport=ta, clock=shared_clock,
+                   name="a", capacity=64, tree_depth=6)
+    b = start_link(AWLWWMap, threaded=False, transport=tb, clock=shared_clock,
+                   name="b", capacity=64, tree_depth=6)
+    a.set_neighbours([tb.remote_addr("b")])
+    a.sync_to_all()
+    assert tb.remote_addr("b") in a._monitors
+    tb.close()  # node death
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and a._monitors:
+        time.sleep(0.05)
+        ta.pump()
+    assert tb.remote_addr("b") not in a._monitors
