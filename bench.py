@@ -1,0 +1,209 @@
+"""North-star benchmark: 1M-key AWLWWMap, 64-neighbour batched anti-entropy.
+
+Measures **merges/sec**: one merge = joining a 512-entry delta slice into
+a 1M-key replica state *and* updating its sync index (the reference's
+``update_state_with_delta``: lattice join + MerkleMap puts,
+``causal_crdt.ex:383-404``). The TPU path executes 64 such merges per
+device call (the vmapped neighbour fan-in, ``parallel/batched_sync.py``).
+
+Baseline: the reference publishes no numbers and Elixir/BEAM is not in
+this image (BASELINE.md), so ``vs_baseline`` is measured against a lean
+pure-Python dot-store implementation of the same semantic steps
+(per-key dot-set join + context union + per-key index update) running
+the identical workload single-threaded. It does O(delta) work per merge
+— a deliberately *favourable* cost model for the baseline (BEAM's
+persistent maps pay O(log n) per touched key plus actor overhead), so
+the reported ratio is conservative.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": "merges/sec", "vs_baseline": N}
+
+Env knobs: BENCH_SMOKE=1 shrinks sizes for CPU smoke runs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+SMOKE = os.environ.get("BENCH_SMOKE") == "1"
+
+N_KEYS = 4096 if SMOKE else 1_000_000
+CAPACITY = 8192 if SMOKE else 1 << 20
+NEIGHBOURS = 4 if SMOKE else 64
+DELTA = 128 if SMOKE else 512
+TREE_DEPTH = 8 if SMOKE else 12
+RCAP = 8
+ITERS = 4 if SMOKE else 48
+WARMUP = 2
+BASE_ITERS = 8 if SMOKE else 200
+# every iteration must be a real merge (fresh dots), not an idempotent
+# re-join — pre-generate enough distinct deltas for both sides
+N_DELTAS = max(ITERS + WARMUP, BASE_ITERS)
+
+log = lambda *a: print(*a, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# workload construction (shared by both sides)
+
+def make_workload(seed=0):
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(1, 1 << 63, size=N_KEYS, dtype=np.uint64)
+    deltas = []
+    ctr0 = 1
+    for d in range(N_DELTAS):
+        dkeys = rng.integers(1, 1 << 63, size=DELTA, dtype=np.uint64)
+        ctrs = np.arange(ctr0, ctr0 + DELTA, dtype=np.uint32)
+        ctr0 += DELTA
+        deltas.append((dkeys, ctrs))
+    return keys, deltas
+
+
+# ---------------------------------------------------------------------------
+# TPU side
+
+def bench_tpu(keys, deltas):
+    import jax
+    import jax.numpy as jnp
+
+    from delta_crdt_ex_tpu.models.state import DotStore
+    from delta_crdt_ex_tpu.ops.hashtree import leaf_digests
+    from delta_crdt_ex_tpu.ops.join import join
+
+    log(f"jax devices: {jax.devices()}")
+
+    num_buckets = 1 << TREE_DEPTH
+
+    def base_state(gid, keys, ctrs, capacity, slot=0):
+        n = len(keys)
+        bucket = (keys & np.uint64(num_buckets - 1)).astype(np.int64)
+        ctx = np.zeros((num_buckets, RCAP), np.uint32)
+        np.maximum.at(ctx, (bucket, np.full(n, slot)), ctrs)
+        pad = capacity - n
+        z = lambda a, dt: np.concatenate([a.astype(dt), np.zeros(pad, dt)])
+        return DotStore(
+            key=jnp.asarray(z(keys, np.uint64)),
+            valh=jnp.asarray(z(ctrs, np.uint32)),
+            ts=jnp.asarray(z(ctrs.astype(np.int64), np.int64)),
+            node=jnp.zeros(capacity, jnp.int32),
+            ctr=jnp.asarray(z(ctrs, np.uint32)),
+            alive=jnp.asarray(np.concatenate([np.ones(n, bool), np.zeros(pad, bool)])),
+            ctx_gid=jnp.zeros(RCAP, jnp.uint64).at[0].set(jnp.uint64(gid)),
+            ctx_max=jnp.asarray(ctx),
+        )
+
+    # one replica state, replicated 64x on the neighbour axis
+    ctrs = np.arange(1, N_KEYS + 1, dtype=np.uint32)
+    one = base_state(11, keys, ctrs, CAPACITY)
+    stacked = jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x, (NEIGHBOURS,) + x.shape).copy(), one
+    )
+
+    # delta slices from a second writer (gid 22): fresh dots each iteration
+    delta_states = [
+        base_state(22, dk, dc, DELTA) for dk, dc in deltas
+    ]
+
+    @jax.jit
+    def merge_step(stacked, delta):
+        res = jax.vmap(join, in_axes=(0, None, None))(stacked, delta, None)
+        # sync-index update (the MerkleMap.put analog): leaf digests refresh
+        leaves = jax.vmap(lambda s: leaf_digests(s, TREE_DEPTH))(res.state)
+        return res.state, res.ok, leaves
+
+    # warmup / compile
+    st = stacked
+    for i in range(WARMUP):
+        st, ok, leaves = merge_step(st, delta_states[i])
+    ok.block_until_ready()
+    assert bool(jnp.all(ok)), "capacity overflow in bench workload"
+    log("tpu compile+warmup done")
+
+    t0 = time.perf_counter()
+    for i in range(ITERS):
+        st, ok, leaves = merge_step(st, delta_states[WARMUP + i])
+    leaves.block_until_ready()
+    dt = time.perf_counter() - t0
+    assert bool(jnp.all(ok))
+    merges = ITERS * NEIGHBOURS
+    log(f"tpu: {merges} merges in {dt:.3f}s")
+    return merges / dt
+
+
+# ---------------------------------------------------------------------------
+# Python baseline (BEAM stand-in; see module docstring)
+
+def bench_python(keys, deltas):
+    num_buckets = 1 << TREE_DEPTH
+    # state: key -> (pair=(valh, ts), dot=(node, ctr)); single-winner per key
+    # (lean model of the nested dot store: the common case is one pair/dot
+    # per key, which is what this workload produces)
+    state = {}
+    ctx = {11: 0}
+    index = dict.fromkeys(range(num_buckets), 0)
+    for i, k in enumerate(keys):
+        kk = int(k)
+        c = i + 1
+        state[kk] = ((c, c), (11, c))
+        ctx[11] = c
+        index[kk & (num_buckets - 1)] ^= hash((kk, c))
+
+    def merge(dkeys, dctrs):
+        # per-key causal join + context union + index update
+        changed = 0
+        for k, c in zip(dkeys, dctrs):
+            kk, cc = int(k), int(c)
+            dot = (22, cc)
+            cur = state.get(kk)
+            covered = ctx.get(22, 0) >= cc
+            if not covered:
+                # s2 \ c1: incorporate the delta entry (LWW vs current)
+                if cur is None or cur[0][1] <= cc:
+                    state[kk] = ((cc, cc), dot)
+                index[kk & (num_buckets - 1)] ^= hash((kk, cc))
+                changed += 1
+        # context union (per-node max over delta dots)
+        top = int(dctrs[-1])
+        if ctx.get(22, 0) < top:
+            ctx[22] = top
+        return changed
+
+    t0 = time.perf_counter()
+    n = 0
+    for i in range(BASE_ITERS):
+        dk, dc = deltas[i]
+        merge(dk, dc)
+        n += 1
+    dt = time.perf_counter() - t0
+    log(f"python baseline: {n} merges in {dt:.3f}s")
+    return n / dt
+
+
+def main():
+    keys, deltas = make_workload()
+    log(f"workload: {N_KEYS} keys, {NEIGHBOURS} neighbours, {DELTA}-entry deltas")
+    py = bench_python(keys, deltas)
+    tpu = bench_tpu(keys, deltas)
+    print(
+        json.dumps(
+            {
+                "metric": "awlwwmap_1m_key_64_neighbour_merges_per_sec"
+                if not SMOKE
+                else "awlwwmap_smoke_merges_per_sec",
+                "value": round(tpu, 2),
+                "unit": "merges/sec",
+                "vs_baseline": round(tpu / py, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+from delta_crdt_ex_tpu.parallel.batched_sync import (
+    fanout_join,
+    jit_fanout_join,
+    jit_ring_gossip_round,
+    ring_gossip_round,
+    stack_states,
+    unstack_states,
+)
+from delta_crdt_ex_tpu.parallel.mesh_gossip import (
+    AXIS,
+    gossip_train_step,
+    make_mesh,
+    place_states,
+    replica_sharding,
+)
+
+__all__ = [
+    "AXIS",
+    "fanout_join",
+    "gossip_train_step",
+    "jit_fanout_join",
+    "jit_ring_gossip_round",
+    "make_mesh",
+    "place_states",
+    "replica_sharding",
+    "ring_gossip_round",
+    "stack_states",
+    "unstack_states",
+]

@@ -1,0 +1,52 @@
+"""Batched neighbour anti-entropy — the vmapped fan-out axis.
+
+The reference loops over neighbours one message at a time
+(``causal_crdt.ex:264-283``); on TPU the neighbour axis becomes a batch
+dimension (SURVEY §2.2): replica states are stacked on a leading axis and
+one device call joins a delta into **all** neighbour states at once — the
+BASELINE north-star's 64-neighbour fan-in. The same shape also batches a
+whole gossip round among N chip-resident replicas (each joins its ring
+predecessor) in one call.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from delta_crdt_ex_tpu.models.state import DotStore
+from delta_crdt_ex_tpu.ops.join import JoinResult, join
+
+
+def stack_states(states: list[DotStore]) -> DotStore:
+    """Stack equally-shaped replica states on a leading neighbour axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+
+
+def unstack_states(stacked: DotStore) -> list[DotStore]:
+    n = stacked.key.shape[0]
+    return [jax.tree_util.tree_map(lambda x: x[i], stacked) for i in range(n)]
+
+
+def fanout_join(
+    stacked: DotStore, delta: DotStore, bucket_mask: jnp.ndarray | None = None
+) -> JoinResult:
+    """Join one delta into N stacked neighbour states in one device call.
+
+    The reference's per-neighbour sync loop, collapsed into a vmap: each
+    neighbour performs its own context remap + dot-set join against the
+    shared delta (states may know different replica sets — the remap is
+    per-neighbour).
+    """
+    return jax.vmap(join, in_axes=(0, None, None))(stacked, delta, bucket_mask)
+
+
+def ring_gossip_round(stacked: DotStore) -> JoinResult:
+    """One full-state gossip round among N chip-resident replicas: replica
+    i joins replica (i-1) mod N. One device call, N joins."""
+    rolled = jax.tree_util.tree_map(lambda x: jnp.roll(x, 1, axis=0), stacked)
+    return jax.vmap(join, in_axes=(0, 0, None))(stacked, rolled, None)
+
+
+jit_fanout_join = jax.jit(fanout_join)
+jit_ring_gossip_round = jax.jit(ring_gossip_round)

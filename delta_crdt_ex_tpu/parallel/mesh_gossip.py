@@ -1,0 +1,109 @@
+"""Multi-chip replication over a device mesh — the ICI data plane.
+
+The reference scales across machines via distributed Erlang's full-mesh
+TCP (SURVEY §5.8). The TPU-native equivalent keeps one replica state
+resident per device of a ``jax.sharding.Mesh`` and moves whole delta
+states device↔device over ICI with ``lax.ppermute`` inside ``shard_map``
+— no host hop, XLA schedules the collective. A gossip *step* is:
+
+1. (optional) apply a per-replica local mutation batch (vmapped
+   ``apply_batch`` — the "compute" of the step);
+2. ``ppermute`` the full state pytree one hop around the ring;
+3. join the received state shard-locally;
+4. rebuild digest-tree roots (the observability/convergence probe).
+
+Ring gossip converges every replica in ≤ N-1 steps (each state travels
+the whole ring); anti-entropy idempotence makes over-delivery harmless —
+semantically this is the reference's neighbour gossip with a ring
+topology, executed as one SPMD program.
+
+The entry-slice (bounded-divergence) variant over ICI is layered the
+same way — extract on device, ppermute fixed-size slices, join — and is
+what :mod:`delta_crdt_ex_tpu.parallel.batched_sync` does within a chip.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from delta_crdt_ex_tpu.models.state import DotStore
+from delta_crdt_ex_tpu.ops.apply import apply_batch
+from delta_crdt_ex_tpu.ops.hashtree import digest_tree
+from delta_crdt_ex_tpu.ops.join import join
+
+AXIS = "replicas"
+
+
+def make_mesh(devices=None) -> Mesh:
+    import numpy as np
+
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (AXIS,))
+
+
+def replica_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading axis = replica, one per device."""
+    return NamedSharding(mesh, P(AXIS))
+
+
+def place_states(states: list[DotStore], mesh: Mesh) -> DotStore:
+    """Stack N replica states and shard one-per-device over the mesh."""
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+    return jax.device_put(stacked, replica_sharding(mesh))
+
+
+def _squeeze(tree):
+    return jax.tree_util.tree_map(lambda x: x[0], tree)
+
+
+def _unsqueeze(tree):
+    return jax.tree_util.tree_map(lambda x: x[None], tree)
+
+
+@partial(jax.jit, static_argnames=("mesh", "depth"))
+def gossip_train_step(
+    mesh: Mesh,
+    stacked: DotStore,
+    self_slot: jnp.ndarray,  # int32[N]   each replica's own ctx slot
+    op: jnp.ndarray,  # int32[N, K]  per-replica mutation batches
+    key: jnp.ndarray,  # uint64[N, K]
+    valh: jnp.ndarray,  # uint32[N, K]
+    ts: jnp.ndarray,  # int64[N, K]
+    depth: int = 6,
+):
+    """One SPMD step: local mutation batch → ring ppermute → join → roots.
+
+    This is the framework's "training step" shape: per-device compute
+    (batched mutation kernels), one ICI collective (ppermute of the full
+    state pytree), then shard-local lattice math. Returns the new stacked
+    states and each replica's digest-tree root (uint32[N]) for
+    convergence monitoring.
+    """
+    n = mesh.devices.size
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    spec = P(AXIS)
+
+    def step(local, slot, op_b, key_b, valh_b, ts_b):
+        local = _squeeze(local)
+        applied, _ok, _ctrs = apply_batch(
+            local, slot[0], op_b[0], key_b[0], valh_b[0], ts_b[0]
+        )
+        received = jax.tree_util.tree_map(
+            lambda x: jax.lax.ppermute(x, AXIS, perm), applied
+        )
+        merged, _ok2, _ins, _kill = join(applied, received, None)
+        root = digest_tree(merged, depth)[0][0]
+        return _unsqueeze(merged), root[None]
+
+    return shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec, spec, spec),
+        out_specs=(spec, spec),
+        check_vma=False,
+    )(stacked, self_slot, op, key, valh, ts)
