@@ -186,17 +186,57 @@ def bench_python(keys, deltas):
     return n / dt
 
 
+def _device_backend_usable(timeout_s: float = 120.0) -> bool:
+    """Probe whether the configured accelerator backend can initialise.
+
+    Device init goes through an external claim that can hang indefinitely
+    when the pool is wedged; probing in a subprocess with a watchdog keeps
+    the bench from hanging the driver. Falls back to CPU (clearly
+    labelled) when the accelerator is unreachable.
+    """
+    import subprocess
+
+    if os.environ.get("JAX_PLATFORMS", "") in ("cpu", ""):
+        return True
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
+    fallback = os.environ.get("BENCH_FORCED_CPU") == "1"
+    if not fallback and not _device_backend_usable():
+        # the accelerator boot hook runs at interpreter start and taints
+        # `import jax` in THIS process too — a clean re-exec with a
+        # scrubbed env is the only reliable fallback
+        log("accelerator backend unreachable — re-exec on CPU (labelled)")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env["BENCH_FORCED_CPU"] = "1"
+        os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
     keys, deltas = make_workload()
     log(f"workload: {N_KEYS} keys, {NEIGHBOURS} neighbours, {DELTA}-entry deltas")
     py = bench_python(keys, deltas)
     tpu = bench_tpu(keys, deltas)
+    metric = (
+        "awlwwmap_1m_key_64_neighbour_merges_per_sec"
+        if not SMOKE
+        else "awlwwmap_smoke_merges_per_sec"
+    )
+    if fallback:
+        metric += "_cpu_fallback"
     print(
         json.dumps(
             {
-                "metric": "awlwwmap_1m_key_64_neighbour_merges_per_sec"
-                if not SMOKE
-                else "awlwwmap_smoke_merges_per_sec",
+                "metric": metric,
                 "value": round(tpu, 2),
                 "unit": "merges/sec",
                 "vs_baseline": round(tpu / py, 3),

@@ -1,0 +1,73 @@
+"""Port of ``bench/basic_operations.exs``: single-replica op latency.
+
+Times ``read`` / ``add`` (new key) / ``update`` (existing key) /
+``remove`` on pre-filled 1k- and 10k-key maps, with the reference's
+``before_each`` churn (re-add key 10, remove "key4"). Also reports the
+TPU-native batched write path (``mutate_async`` + one flush), which is
+how this framework is meant to be driven.
+
+Run: ``python -m benchmarks.basic_operations``
+"""
+
+from __future__ import annotations
+
+import time
+
+from delta_crdt_ex_tpu import AWLWWMap
+from delta_crdt_ex_tpu.api import start_link
+from benchmarks.common import emit, log
+
+
+def setup_crdt(n):
+    crdt = start_link(AWLWWMap, threaded=False, capacity=max(2048, 4 * n), tree_depth=10)
+    for x in range(n):
+        crdt.mutate_async("add", [x + 1, x + 1])
+    crdt.flush()
+    return crdt
+
+
+def time_op(crdt, fn, iters=200):
+    # before_each churn, mirroring the reference
+    for _ in range(3):  # burn-in
+        crdt.mutate("add", [10, 10])
+        crdt.mutate("remove", ["key4"])
+        fn(crdt)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        crdt.mutate("add", [10, 10])
+        crdt.mutate("remove", ["key4"])
+        fn(crdt)
+    per_iter = (time.perf_counter() - t0) / iters
+    return per_iter
+
+
+def main():
+    results = {}
+    for n in (1000, 10_000):
+        crdt = setup_crdt(n)
+        ops = {
+            "read": lambda c: c.read(),
+            "add": lambda c: c.mutate("add", ["key4", "value"]),
+            "update": lambda c: c.mutate("add", [10, 12]),
+            "remove": lambda c: c.mutate("remove", [10]),
+        }
+        for name, fn in ops.items():
+            per = time_op(crdt, fn)
+            results[f"{name}@{n}"] = round(1.0 / per, 1)
+            log(f"{name} @ {n} keys: {1.0/per:.1f} composite-iters/sec")
+
+        # TPU-native batched writes: 1000 adds in one flush
+        t0 = time.perf_counter()
+        for x in range(1_000_000, 1_001_000):
+            crdt.mutate_async("add", [x, x])
+        crdt.flush()
+        dt = time.perf_counter() - t0
+        results[f"batched_add@{n}"] = round(1000 / dt, 1)
+        log(f"batched add @ {n} keys: {1000/dt:.1f} ops/sec")
+        crdt.stop()
+    emit("basic_operations", results)
+    return results
+
+
+if __name__ == "__main__":
+    main()

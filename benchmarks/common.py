@@ -1,0 +1,68 @@
+"""Shared helpers for the ported reference benchmarks (``bench/*.exs``)."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from delta_crdt_ex_tpu import AWLWWMap
+from delta_crdt_ex_tpu.api import start_link
+from delta_crdt_ex_tpu.runtime.transport import LocalTransport
+
+log = lambda *a: print(*a, file=sys.stderr, flush=True)
+
+
+def make_pair(transport=None, **opts):
+    """Two deterministic replicas wired bidirectionally."""
+    transport = transport or LocalTransport()
+    opts.setdefault("threaded", False)
+    c1 = start_link(AWLWWMap, transport=transport, **opts)
+    c2 = start_link(AWLWWMap, transport=transport, **opts)
+    c1.set_neighbours([c2])
+    c2.set_neighbours([c1])
+    transport.pump()
+    return transport, c1, c2
+
+
+def converge(transport, replicas, predicate, max_rounds=10_000):
+    """Drive sync rounds until ``predicate()`` holds; returns rounds used."""
+    for r in range(max_rounds):
+        if predicate():
+            return r
+        for rep in replicas:
+            rep.sync_to_all()
+        transport.pump()
+    raise RuntimeError("did not converge")
+
+
+class BenchRecorder:
+    """Convergence detector (reference ``BenchRecorder``,
+    ``bench/propagation.exs:1-34``): watches an ``on_diffs`` feed for
+    sentinel add/remove diffs."""
+
+    def __init__(self):
+        self.adds: set = set()
+        self.removes: set = set()
+
+    def on_diffs(self, diffs):
+        for d in diffs:
+            if d[0] == "add":
+                self.adds.add(d[1])
+            else:
+                self.removes.add(d[1])
+
+    def wait(self, key, kind="add", timeout=60.0) -> bool:
+        import time as _t
+
+        seen = self.adds if kind == "add" else self.removes
+        deadline = _t.monotonic() + timeout
+        while _t.monotonic() < deadline:
+            if key in seen:
+                return True
+            _t.sleep(0.001)
+        return False
+
+
+def emit(name: str, results: dict):
+    log(json.dumps({"bench": name, **results}, default=float))

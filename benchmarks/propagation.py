@@ -1,0 +1,73 @@
+"""Port of ``bench/propagation.exs``: propagation latency into a
+pre-synced 2-replica pair.
+
+Prepare: fill c1 with N keys, wait until c2 converges (BenchRecorder
+sentinel on c2's ``on_diffs``), then ``hibernate`` + ``ping`` both
+replicas (reference ``propagation.exs:61-64``). Measure: wall-clock for
+10 adds / 10 removes at c1 to be observed at c2, with real background
+sync threads at ``sync_interval`` 5 ms (reference ``:38-44``).
+
+Run: ``python -m benchmarks.propagation [N ...]``  (default 20000 30000)
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from delta_crdt_ex_tpu import AWLWWMap
+from delta_crdt_ex_tpu.api import start_link
+from delta_crdt_ex_tpu.runtime.transport import LocalTransport
+from benchmarks.common import BenchRecorder, emit, log
+
+
+def prepare(number):
+    transport = LocalTransport()
+    rec = BenchRecorder()
+    c1 = start_link(AWLWWMap, transport=transport, sync_interval=0.005,
+                    capacity=max(4096, 4 * number), tree_depth=12, max_sync_size=500)
+    c2 = start_link(AWLWWMap, transport=transport, sync_interval=0.005,
+                    on_diffs=rec.on_diffs,
+                    capacity=max(4096, 4 * number), tree_depth=12, max_sync_size=500)
+    c1.set_neighbours([c2])
+    c2.set_neighbours([c1])
+    for x in range(1, number + 1):
+        c1.mutate_async("add", [x, x])
+    assert rec.wait(number, "add", timeout=120), "initial convergence timed out"
+    c1.hibernate(), c2.hibernate()
+    c1.ping(), c2.ping()
+    return transport, rec, c1, c2
+
+
+def perform(pair, op):
+    transport, rec, c1, c2 = pair
+    t0 = time.perf_counter()
+    if op == "add":
+        for x in range(100_000, 100_011):
+            c1.mutate("add", [x, x])
+        assert rec.wait(100_010, "add"), "add propagation timed out"
+    else:
+        for x in range(1, 11):
+            c1.mutate("remove", [x])
+        assert rec.wait(10, "remove"), "remove propagation timed out"
+    dt = time.perf_counter() - t0
+    c1.stop()
+    c2.stop()
+    return dt
+
+
+def main(sizes=(20_000, 30_000)):
+    results = {}
+    for n in sizes:
+        for op in ("add", "remove"):
+            log(f"preparing {n}-key pair for {op}…")
+            dt = perform(prepare(n), op)
+            results[f"{op}10@{n}"] = round(dt * 1000, 2)
+            log(f"{op} 10 into {n}-key pair: {dt*1000:.1f} ms")
+    emit("propagation", results)
+    return results
+
+
+if __name__ == "__main__":
+    sizes = tuple(int(a) for a in sys.argv[1:]) or (20_000, 30_000)
+    main(sizes)

@@ -43,6 +43,8 @@ class ApplyResult(NamedTuple):
     state: DotStore
     ok: jnp.ndarray  # bool
     ctr_assigned: jnp.ndarray  # uint32[K]: dot counter per add op (host payload keying)
+    n_keys_changed: jnp.ndarray  # int32: unique keys whose dot store changed
+    # (the reference's telemetry keys_updated_count, causal_crdt.ex:396-398)
 
 
 def apply_batch(
@@ -109,4 +111,18 @@ def apply_batch(
         ctx_gid=state.ctx_gid,
         ctx_max=ctx_max,
     )
-    return ApplyResult(new_state, ok, ctr_assigned)
+
+    # unique keys whose dot store changed: a pre-batch entry died, or a
+    # surviving add inserted. Marks land on the sorted-batch-key axis
+    # (searchsorted gives one canonical slot per distinct key).
+    kill_mark = touched & state.alive & ~alive1
+    changed = jnp.zeros(k, bool).at[jnp.where(kill_mark, jnp.clip(pos, 0, k - 1), k)].set(
+        True, mode="drop"
+    )
+    ins_pos = jnp.searchsorted(s, key)
+    changed = changed.at[
+        jnp.where(ins_alive, jnp.clip(ins_pos, 0, k - 1), k)
+    ].set(True, mode="drop")
+    n_keys_changed = jnp.sum(changed.astype(jnp.int32))
+
+    return ApplyResult(new_state, ok, ctr_assigned, n_keys_changed)

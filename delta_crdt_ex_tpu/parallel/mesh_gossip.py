@@ -90,9 +90,9 @@ def gossip_train_step(
 
     def step(local, slot, op_b, key_b, valh_b, ts_b):
         local = _squeeze(local)
-        applied, _ok, _ctrs = apply_batch(
+        applied = apply_batch(
             local, slot[0], op_b[0], key_b[0], valh_b[0], ts_b[0]
-        )
+        ).state
         received = jax.tree_util.tree_map(
             lambda x: jax.lax.ppermute(x, AXIS, perm), applied
         )

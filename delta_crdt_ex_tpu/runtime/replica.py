@@ -131,6 +131,21 @@ class Replica:
             self.self_slot = 0
 
         self.transport.register(self.name, self)
+        self._warmup()
+
+    def _warmup(self) -> None:
+        """Pre-trigger the jit compile of the single-op mutate tier so the
+        first user mutate doesn't pay it (compile caches are process-wide:
+        only the first replica of a given capacity tier compiles)."""
+        k = _pow2(1)
+        self.model.apply_batch(
+            self.state,
+            jnp.int32(self.self_slot),
+            jnp.zeros(k, jnp.int32),
+            jnp.zeros(k, jnp.uint64),
+            jnp.zeros(k, jnp.uint32),
+            jnp.zeros(k, jnp.int64),
+        )
 
     # ------------------------------------------------------------------
     # rehydrate / persist (reference causal_crdt.ex:216-250)
@@ -194,6 +209,11 @@ class Replica:
         else:
             self._pending.append(("clear", None, None))
 
+    def flush(self) -> None:
+        """Apply queued async mutations now (without reading)."""
+        with self._lock:
+            self._flush()
+
     def read(self, timeout: float | None = None) -> dict:
         with self._lock:
             self._flush()
@@ -236,11 +256,18 @@ class Replica:
     # ------------------------------------------------------------------
     # local mutation batch
 
+    #: largest mutation batch applied in one kernel call — the batch
+    #: shadowing matrix is K², so cap and chunk (diffs bundle per chunk,
+    #: consistent with the reference's per-sync-round bundling)
+    MAX_BATCH = 1024
+
     def _flush(self) -> None:
-        if not self._pending:
-            return
-        batch = self._pending
-        self._pending = []
+        while self._pending:
+            batch = self._pending[: self.MAX_BATCH]
+            self._pending = self._pending[self.MAX_BATCH :]
+            self._flush_batch(batch)
+
+    def _flush_batch(self, batch: list) -> None:
         n = len(batch)
         k = _pow2(n)
 
@@ -271,7 +298,11 @@ class Replica:
             if f != "clear":
                 touched[int(key[i])] = key_term
 
-        w_before = self._batch_winner_records(touched, any_clear)
+        # the before/after winner passes exist only to feed the diff
+        # callback (and clear's full-map diff); without a subscriber the
+        # kernel's own changed-key count serves telemetry
+        need_winners = self.on_diffs is not None or any_clear
+        w_before = self._batch_winner_records(touched, any_clear) if need_winners else {}
         res = self._apply_with_growth(op, key, valh, ts)
         self._seq += 1
 
@@ -292,11 +323,21 @@ class Replica:
                 _f, key_term, value = batch[i]
                 self._payloads[(self.node_id, int(ctr_assigned[i]))] = (key_term, value)
 
-        w_after = self._batch_winner_records(touched, any_clear)
-        touched_all = dict(touched)
-        for kh in set(w_before) | set(w_after):
-            touched_all.setdefault(kh, self._key_terms.get(kh))
-        self._emit_diffs(touched_all, w_before, w_after)
+        if need_winners:
+            w_after = self._batch_winner_records(touched, any_clear)
+            touched_all = dict(touched)
+            for kh in set(w_before) | set(w_after):
+                touched_all.setdefault(kh, self._key_terms.get(kh))
+            self._emit_diffs(touched_all, w_before, w_after)
+        else:
+            self._tree = None
+            self._read_cache = None
+            if telemetry.has_handlers(telemetry.SYNC_DONE):
+                telemetry.execute(
+                    telemetry.SYNC_DONE,
+                    {"keys_updated_count": int(res.n_keys_changed)},
+                    {"name": self.name},
+                )
         self._persist()
 
     def _batch_winner_records(self, touched: dict[int, Any], full: bool) -> dict[int, tuple]:
@@ -631,6 +672,25 @@ class Replica:
             for i in range(count)
         }
         return w, records
+
+    # ------------------------------------------------------------------
+    # bench parity helpers (reference BenchmarkHelper, benchmark_helper.ex:
+    # 2-14 — :hibernate forces GC-like compaction before timing, :ping
+    # round-trips the mailbox)
+
+    def hibernate(self) -> str:
+        """Quiesce before timing: flush, prune host dicts, drain device."""
+        import jax
+
+        with self._lock:
+            self._flush()
+            self.gc()
+            jax.block_until_ready(self.state)
+        return "ok"
+
+    def ping(self) -> str:
+        with self._lock:
+            return "ok"
 
     # ------------------------------------------------------------------
     # payload GC (host dictionaries must track device alive masks)

@@ -32,6 +32,11 @@ def detach(event: tuple, handler: Callable) -> None:
             _handlers[event].remove(handler)
 
 
+def has_handlers(event: tuple) -> bool:
+    with _lock:
+        return bool(_handlers.get(event))
+
+
 def execute(event: tuple, measurements: dict, metadata: dict) -> None:
     with _lock:
         handlers = list(_handlers.get(event, []))
