@@ -40,7 +40,7 @@ from delta_crdt_ex_tpu.utils.hashing import key_hash64, value_hash32
 from delta_crdt_ex_tpu.models.aw_lww_map import AWLWWMap
 from delta_crdt_ex_tpu.models.state import DotStore
 from delta_crdt_ex_tpu.ops.apply import OP_ADD, OP_CLEAR, OP_PAD, OP_REMOVE
-from delta_crdt_ex_tpu.runtime import sync as sync_proto, telemetry
+from delta_crdt_ex_tpu.runtime import sync as sync_proto, telemetry, tracing
 from delta_crdt_ex_tpu.runtime.clock import Clock
 from delta_crdt_ex_tpu.runtime.storage import Snapshot, Storage
 from delta_crdt_ex_tpu.runtime.transport import Down, LocalTransport, default_transport
@@ -76,6 +76,8 @@ class Replica:
         replica_capacity: int = 64,
         tree_depth: int = 12,
         levels_per_round: int = 8,
+        sync_timeout: float | None = None,
+        checkpoint_interval: float = 5.0,
     ):
         # max_sync_size validation (reference raises, causal_crdt.ex:52-62)
         if max_sync_size == "infinite":
@@ -91,11 +93,19 @@ class Replica:
         self.on_diffs = on_diffs
         self.storage_module = storage_module
         self.storage_mode = storage_mode
+        self.checkpoint_interval = checkpoint_interval
         self.tree_depth = tree_depth
         self.num_buckets = 1 << tree_depth
         self.levels_per_round = levels_per_round
         self.transport = transport or default_transport()
         self.clock = clock or Clock()
+        # The reference's outstanding_syncs slot is cleared only by an ack
+        # or a DOWN (causal_crdt.ex:82-84,127-145) — safe on the BEAM's
+        # reliable links, but a lost message would stall the edge forever
+        # on a lossy transport. In-flight slots therefore expire.
+        self.sync_timeout = (
+            sync_timeout if sync_timeout is not None else max(10 * sync_interval, 2.0)
+        )
 
         self._lock = threading.RLock()
         self._pending: list[tuple[str, Any, Any]] = []  # (op, key_term, value)
@@ -265,7 +275,8 @@ class Replica:
         while self._pending:
             batch = self._pending[: self.MAX_BATCH]
             self._pending = self._pending[self.MAX_BATCH :]
-            self._flush_batch(batch)
+            with tracing.annotate("crdt.flush"):
+                self._flush_batch(batch)
 
     def _flush_batch(self, batch: list) -> None:
         n = len(batch)
@@ -487,15 +498,19 @@ class Replica:
             self._monitor_neighbours()
             tree = self._ensure_tree()
             root = np.zeros(1, np.int64)
+            now = time.monotonic()
             for n in list(self._monitors):
-                if n == self.addr or n in self._outstanding:
+                if n == self.addr:
                     continue
+                expiry = self._outstanding.get(n)
+                if expiry is not None and now < expiry:
+                    continue  # ≤1 in-flight sync per neighbour
                 blocks = sync_proto.make_blocks(tree, 0, root, self.levels_per_round)
                 msg = sync_proto.DiffMsg(
                     originator=self.addr, frm=self.addr, to=n, level=0, idx=root, blocks=blocks
                 )
                 if self.transport.send(n, msg):
-                    self._outstanding[n] = 1
+                    self._outstanding[n] = now + self.sync_timeout
                 else:
                     logger.debug("tried to sync with a dead neighbour: %r", n)
 
@@ -604,6 +619,10 @@ class Replica:
         )
 
     def _handle_entries(self, msg: sync_proto.EntriesMsg) -> None:
+        with tracing.annotate("crdt.merge"):
+            self._handle_entries_inner(msg)
+
+    def _handle_entries_inner(self, msg: sync_proto.EntriesMsg) -> None:
         self._flush()
         t0 = time.perf_counter()
         entry_cols = {c: jnp.asarray(msg.arrays[c]) for c in _SLICE_COLUMNS}
@@ -735,6 +754,7 @@ class Replica:
 
         def loop():
             next_sync = time.monotonic()  # immediate first sync
+            next_ckpt = time.monotonic() + self.checkpoint_interval
             while not self._stop.is_set():
                 self.process_pending()
                 with self._lock:
@@ -744,6 +764,15 @@ class Replica:
                 if now >= next_sync:
                     self.sync_to_all()
                     next_sync = now + self.sync_interval
+                if (
+                    self.storage_mode == "interval"
+                    and self.storage_module is not None
+                    and now >= next_ckpt
+                ):
+                    # async-cadence snapshot — the TPU-sane alternative to
+                    # the reference's write-through-per-op (SURVEY §5.4)
+                    self.checkpoint()
+                    next_ckpt = now + self.checkpoint_interval
                 self._wake.wait(timeout=max(0.0, min(next_sync - time.monotonic(), 0.05)))
                 self._wake.clear()
 
@@ -764,4 +793,6 @@ class Replica:
             self.sync_to_all()
         except Exception:  # best-effort, like the reference's TODO-marked path
             logger.debug("final sync on terminate failed", exc_info=True)
+        if self.storage_mode == "interval" and self.storage_module is not None:
+            self.checkpoint()
         self.transport.unregister(self.name)

@@ -1,0 +1,73 @@
+"""Auxiliary subsystems: tracing, interval checkpointing, telemetry, gc."""
+
+import time
+
+from delta_crdt_ex_tpu import AWLWWMap, MemoryStorage
+from delta_crdt_ex_tpu.api import start_link
+from delta_crdt_ex_tpu.runtime import telemetry
+from delta_crdt_ex_tpu.runtime.tracing import profile_mutations
+
+
+def mk(transport, clock, **opts):
+    opts.setdefault("capacity", 64)
+    opts.setdefault("tree_depth", 6)
+    return start_link(AWLWWMap, threaded=False, transport=transport, clock=clock, **opts)
+
+
+def test_profile_mutations(transport, shared_clock):
+    c = mk(transport, shared_clock)
+    out = profile_mutations(c, n=20)
+    assert out["mutations"] == 20 and out["total_s"] > 0
+    assert len(c.read()) == 20
+
+
+def test_telemetry_sync_done_counts(transport, shared_clock):
+    events = []
+    telemetry.attach(telemetry.SYNC_DONE, lambda e, m, md: events.append((m, md)))
+    try:
+        c = mk(transport, shared_clock, name="telem")
+        c.mutate("add", ["a", 1])
+        c.mutate("add", ["a", 1])  # same value, NEW dot: internal change
+        c.mutate("remove", ["missing"])  # no internal change
+        counts = [m["keys_updated_count"] for m, md in events if md["name"] == "telem"]
+        assert counts == [1, 1, 0]
+    finally:
+        telemetry.detach(telemetry.SYNC_DONE, events.append)
+
+
+def test_interval_checkpointing_rehydrates(transport, shared_clock):
+    store = MemoryStorage()
+    c = start_link(
+        AWLWWMap,
+        transport=transport,
+        clock=shared_clock,
+        name="ickpt",
+        storage_module=store,
+        storage_mode="interval",
+        checkpoint_interval=0.05,
+        sync_interval=0.02,
+        capacity=64,
+        tree_depth=6,
+    )
+    c.mutate("add", ["k", "v"])
+    deadline = time.monotonic() + 5
+    while store.read("ickpt") is None and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert store.read("ickpt") is not None, "interval checkpoint never fired"
+    c.stop()
+
+    c2 = mk(transport, shared_clock, name="ickpt", storage_module=store)
+    assert c2.read() == {"k": "v"}
+
+
+def test_gc_prunes_dead_payloads(transport, shared_clock):
+    c = mk(transport, shared_clock)
+    for i in range(10):
+        c.mutate("add", [f"k{i}", i])
+    for i in range(5):
+        c.mutate("remove", [f"k{i}"])
+    assert len(c._payloads) >= 10  # dead dots still held
+    c.gc()
+    assert len(c._payloads) == 5
+    assert len(c._key_terms) == 5
+    assert c.read() == {f"k{i}": i for i in range(5, 10)}
