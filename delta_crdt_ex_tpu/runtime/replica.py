@@ -36,7 +36,12 @@ from typing import Any, Callable
 import jax.numpy as jnp
 import numpy as np
 
-from delta_crdt_ex_tpu.utils.hashing import key_hash64, value_hash32
+from delta_crdt_ex_tpu.utils.hashing import (
+    key_hash64,
+    key_hash64_batch,
+    value_hash32,
+    value_hash32_batch,
+)
 from delta_crdt_ex_tpu.models.aw_lww_map import AWLWWMap
 from delta_crdt_ex_tpu.models.state import DotStore
 from delta_crdt_ex_tpu.ops.apply import OP_ADD, OP_CLEAR, OP_PAD, OP_REMOVE
@@ -287,14 +292,21 @@ class Replica:
         valh = np.zeros(k, np.uint32)
         ts = np.zeros(k, np.int64)
         any_clear = False
+        batch_hashes = None
+        if n >= 32:
+            # one native call hashes the whole batch (keys and values)
+            batch_hashes = (
+                key_hash64_batch([t for _f, t, _v in batch]),
+                value_hash32_batch([v for _f, _t, v in batch]),
+            )
         for i, (f, key_term, value) in enumerate(batch):
             if f == "add":
                 op[i] = OP_ADD
-                key[i] = key_hash64(key_term)
-                valh[i] = value_hash32(value)
+                key[i] = batch_hashes[0][i] if batch_hashes else key_hash64(key_term)
+                valh[i] = batch_hashes[1][i] if batch_hashes else value_hash32(value)
             elif f == "remove":
                 op[i] = OP_REMOVE
-                key[i] = key_hash64(key_term)
+                key[i] = batch_hashes[0][i] if batch_hashes else key_hash64(key_term)
             else:
                 op[i] = OP_CLEAR
                 any_clear = True

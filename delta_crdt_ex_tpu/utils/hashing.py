@@ -85,3 +85,35 @@ def value_hash32(term) -> int:
     """32-bit value digest (content hint for the sync index)."""
     d = blake2b(canonical_bytes(term), digest_size=4).digest()
     return int.from_bytes(d, "big")
+
+
+def key_hash64_batch(terms: list):
+    """uint64 key ids for a term batch — native batch hasher when built
+    (bit-identical to the hashlib path, enforced by tests/test_native.py),
+    per-term hashlib otherwise."""
+    import numpy as np
+
+    from delta_crdt_ex_tpu import native
+
+    blobs = [canonical_bytes(t) for t in terms]
+    out = native.hash64_batch(blobs)
+    if out is None:
+        out = np.empty(len(terms), np.uint64)
+        for i, b in enumerate(blobs):
+            out[i] = int.from_bytes(blake2b(b, digest_size=8).digest(), "big") or 1
+    return out
+
+
+def value_hash32_batch(terms: list):
+    """uint32 value digests for a term batch (see key_hash64_batch)."""
+    import numpy as np
+
+    from delta_crdt_ex_tpu import native
+
+    blobs = [canonical_bytes(t) for t in terms]
+    out = native.hash32_batch(blobs)
+    if out is None:
+        out = np.empty(len(terms), np.uint32)
+        for i, b in enumerate(blobs):
+            out[i] = int.from_bytes(blake2b(b, digest_size=4).digest(), "big")
+    return out

@@ -1,0 +1,46 @@
+"""Native fasthash must agree bit-for-bit with the Python hashlib path —
+mixed native/non-native clusters depend on it."""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from delta_crdt_ex_tpu import native
+from delta_crdt_ex_tpu.utils.hashing import (
+    canonical_bytes,
+    key_hash64,
+    value_hash32,
+)
+
+
+@pytest.mark.skipif(not native.available(), reason="no C++ toolchain")
+def test_hash64_matches_hashlib():
+    rng = random.Random(7)
+    terms = [
+        "",
+        "x",
+        b"\x00" * 128,  # exactly one block
+        b"\x01" * 129,  # block boundary + 1
+        ("tuple", 1, 2.5, None),
+        list(range(50)),
+        {"k": {"nested": [1, 2, 3]}},
+    ] + [rng.randbytes(rng.randint(0, 1000)) for _ in range(200)]
+    blobs = [canonical_bytes(t) for t in terms]
+    got = native.hash64_batch(blobs)
+    want = np.array([key_hash64(t) for t in terms], np.uint64)
+    assert (got == want).all()
+
+
+@pytest.mark.skipif(not native.available(), reason="no C++ toolchain")
+def test_hash32_matches_hashlib():
+    terms = ["a", 1, None, b"bytes", (1, 2), {"x": 1}] + [f"v{i}" for i in range(100)]
+    blobs = [canonical_bytes(t) for t in terms]
+    got = native.hash32_batch(blobs)
+    want = np.array([value_hash32(t) for t in terms], np.uint32)
+    assert (got == want).all()
+
+
+def test_batch_helpers_accept_empty():
+    assert native.hash64_batch([]) is None
