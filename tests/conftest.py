@@ -1,18 +1,27 @@
 """Test harness config.
 
 Tests run on CPU with 8 virtual devices so multi-chip sharding paths
-(`parallel/`) are exercised without TPU hardware; the env vars must be in
-place before JAX initialises its backends.
+(`parallel/`) are exercised without TPU hardware. Because this image
+pre-imports jax at interpreter startup, the platform must be forced via
+``jax.config.update`` (see below) — env vars alone are too late.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: the ambient env pins the TPU plugin
+# The ambient image pre-imports jax via an axon sitecustomize, so JAX_PLATFORMS
+# has already been snapshotted into jax.config before this conftest runs —
+# env-var writes alone are too late. XLA_FLAGS is still read lazily at first
+# backend init, so set it here, then override the platform via jax.config.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
