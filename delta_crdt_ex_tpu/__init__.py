@@ -31,17 +31,6 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
-from delta_crdt_ex_tpu.api import (  # noqa: E402
-    DeltaCrdt,
-    mutate,
-    mutate_async,
-    read,
-    set_neighbours,
-    start_link,
-)
-from delta_crdt_ex_tpu.models.aw_lww_map import AWLWWMap  # noqa: E402
-from delta_crdt_ex_tpu.runtime.storage import MemoryStorage, Storage  # noqa: E402
-
 __version__ = "0.1.0"
 
 __all__ = [
@@ -55,3 +44,44 @@ __all__ = [
     "set_neighbours",
     "start_link",
 ]
+
+# PEP 562 lazy exports: several modules build jnp constants at import
+# time, which initialises the XLA backend — too early for helpers like
+# utils.devices.force_cpu_devices that must run before first backend
+# init. Resolving the public surface on first attribute access keeps
+# `import delta_crdt_ex_tpu` backend-free.
+_EXPORTS = {
+    "AWLWWMap": ("delta_crdt_ex_tpu.models.aw_lww_map", "AWLWWMap"),
+    "DeltaCrdt": ("delta_crdt_ex_tpu.api", "DeltaCrdt"),
+    "MemoryStorage": ("delta_crdt_ex_tpu.runtime.storage", "MemoryStorage"),
+    "Storage": ("delta_crdt_ex_tpu.runtime.storage", "Storage"),
+    "mutate": ("delta_crdt_ex_tpu.api", "mutate"),
+    "mutate_async": ("delta_crdt_ex_tpu.api", "mutate_async"),
+    "read": ("delta_crdt_ex_tpu.api", "read"),
+    "set_neighbours": ("delta_crdt_ex_tpu.api", "set_neighbours"),
+    "start_link": ("delta_crdt_ex_tpu.api", "start_link"),
+}
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))
+
+
+def __getattr__(name):
+    import importlib
+
+    try:
+        module, attr = _EXPORTS[name]
+    except KeyError:
+        try:
+            value = importlib.import_module(f"{__name__}.{name}")
+        except ModuleNotFoundError as e:
+            if e.name != f"{__name__}.{name}":
+                raise  # real failure inside an existing submodule
+            raise AttributeError(
+                f"module {__name__!r} has no attribute {name!r}"
+            ) from None
+    else:
+        value = getattr(importlib.import_module(module), attr)
+    globals()[name] = value
+    return value

@@ -10,18 +10,12 @@ import os
 
 # The ambient image pre-imports jax via an axon sitecustomize, so JAX_PLATFORMS
 # has already been snapshotted into jax.config before this conftest runs —
-# env-var writes alone are too late. XLA_FLAGS is still read lazily at first
-# backend init, so set it here, then override the platform via jax.config.
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# env-var writes alone are too late; force_cpu_devices handles the dance.
 os.environ["JAX_PLATFORMS"] = "cpu"
 
-import jax  # noqa: E402
+from delta_crdt_ex_tpu.utils.devices import force_cpu_devices  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu_devices(8)
 
 import pytest  # noqa: E402
 
