@@ -46,6 +46,9 @@ def force_cpu_devices(n: int) -> None:
     else:
         flags = f"{flags} --{_FLAG}={n}".strip()
     os.environ["XLA_FLAGS"] = flags
+    # config updates don't propagate to subprocesses — keep the env var in
+    # step so children inherit the CPU platform too
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
     import jax
 

@@ -6,14 +6,10 @@ pre-imports jax at interpreter startup, the platform must be forced via
 ``jax.config.update`` (see below) — env vars alone are too late.
 """
 
-import os
-
 # The ambient image pre-imports jax via an axon sitecustomize, so JAX_PLATFORMS
-# has already been snapshotted into jax.config before this conftest runs —
-# env-var writes alone are too late; force_cpu_devices handles the dance.
-os.environ["JAX_PLATFORMS"] = "cpu"
-
-from delta_crdt_ex_tpu.utils.devices import force_cpu_devices  # noqa: E402
+# env-var writes alone are too late; force_cpu_devices handles the dance
+# (jax.config update + env var for subprocesses).
+from delta_crdt_ex_tpu.utils.devices import force_cpu_devices
 
 force_cpu_devices(8)
 

@@ -1,0 +1,127 @@
+"""Diff change-feed tests — ports of ``test/delta_subscriber_test.exs``.
+
+Covers the reference's ``on_diffs`` emission rules:
+- callback as a plain function and as the (fn, extra_args) tuple form
+  (the reference's MFA shape, ``causal_crdt.ex:361-381``);
+- no-op writes are silent (``delta_subscriber_test.exs:23-24``);
+- ``add k, nil`` emits a ``("remove", k)`` diff (``:26-27``);
+- diffs bundle per sync round (``:49-77``);
+- replaying the diff stream reconstructs the map (property test,
+  ``:79-133``).
+"""
+
+import random
+
+from delta_crdt_ex_tpu import AWLWWMap
+from delta_crdt_ex_tpu.api import start_link
+from tests.conftest import converge
+
+
+def mk(transport, clock, **opts):
+    opts.setdefault("capacity", 64)
+    opts.setdefault("tree_depth", 6)
+    return start_link(
+        AWLWWMap, threaded=False, transport=transport, clock=clock, **opts
+    )
+
+
+def test_on_diffs_as_function(transport, shared_clock):
+    seen = []
+    c = mk(transport, shared_clock, on_diffs=seen.append)
+    c.mutate("add", ["Derek", "Kraan"])
+    assert seen == [[("add", "Derek", "Kraan")]]
+    c.mutate("remove", ["Derek"])
+    assert seen == [[("add", "Derek", "Kraan")], [("remove", "Derek")]]
+
+
+def test_on_diffs_as_mfa_tuple(transport, shared_clock):
+    """The reference's {m, f, a} form: extra args are prepended
+    (``causal_crdt.ex:363-366``)."""
+    seen = []
+
+    def recorder(tag, diffs):
+        seen.append((tag, diffs))
+
+    c = mk(transport, shared_clock, on_diffs=(recorder, ["tagged"]))
+    c.mutate("add", ["Derek", "Kraan"])
+    assert seen == [("tagged", [("add", "Derek", "Kraan")])]
+
+
+def test_noop_write_emits_no_diff(transport, shared_clock):
+    """Re-adding an existing key/value pair changes dots but not the read
+    value — the user callback stays silent (``delta_subscriber_test.exs:23-24``)."""
+    seen = []
+    c = mk(transport, shared_clock, on_diffs=seen.append)
+    c.mutate("add", ["Derek", "Kraan"])
+    c.mutate("add", ["Derek", "Kraan"])
+    assert seen == [[("add", "Derek", "Kraan")]]
+
+
+def test_add_nil_value_emits_remove_diff(transport, shared_clock):
+    """``add(k, nil)`` reads as absent, so the diff is a remove
+    (``delta_subscriber_test.exs:26-27``)."""
+    seen = []
+    c = mk(transport, shared_clock, on_diffs=seen.append)
+    c.mutate("add", ["Derek", "Kraan"])
+    c.mutate("add", ["Derek", None])
+    assert seen == [[("add", "Derek", "Kraan")], [("remove", "Derek")]]
+
+
+def test_remove_of_absent_key_is_silent(transport, shared_clock):
+    seen = []
+    c = mk(transport, shared_clock, on_diffs=seen.append)
+    c.mutate("remove", ["never-added"])
+    assert seen == []
+
+
+def test_diffs_bundle_per_sync_round(transport, shared_clock):
+    """Remote deltas arriving in one sync round land in ONE callback
+    invocation (``delta_subscriber_test.exs:49-77``)."""
+    seen = []
+    c1 = mk(transport, shared_clock)
+    c2 = mk(transport, shared_clock, on_diffs=seen.append)
+    for i in range(8):
+        c1.mutate_async("add", [f"k{i}", i])
+    c1.flush()
+    c1.set_neighbours([c2])
+    converge(transport, [c1, c2])
+    assert len(seen) >= 1
+    flat = [d for bundle in seen for d in bundle]
+    assert sorted(flat) == sorted(("add", f"k{i}", i) for i in range(8))
+    # bundling: far fewer callback invocations than diffs
+    assert len(seen) < len(flat)
+
+
+def test_replaying_diffs_reconstructs_map(transport, shared_clock):
+    """Property (``delta_subscriber_test.exs:79-133``): a subscriber that
+    folds the diff stream into a plain dict ends up with exactly the
+    replica's read() after convergence."""
+    rng = random.Random(7)
+    replay: dict = {}
+
+    def apply_diffs(diffs):
+        for d in diffs:
+            if d[0] == "add":
+                replay[d[1]] = d[2]
+            else:
+                replay.pop(d[1], None)
+
+    c1 = mk(transport, shared_clock, capacity=256)
+    c2 = mk(transport, shared_clock, capacity=256, on_diffs=apply_diffs)
+    c1.set_neighbours([c2])
+    c2.set_neighbours([c1])
+
+    keys = [f"key-{i}" for i in range(12)]
+    for step in range(60):
+        k = rng.choice(keys)
+        writer = rng.choice([c1, c2])
+        if rng.random() < 0.7:
+            writer.mutate("add", [k, rng.randrange(1000)])
+        else:
+            writer.mutate("remove", [k])
+        if step % 10 == 9:
+            converge(transport, [c1, c2])
+    converge(transport, [c1, c2])
+
+    assert c1.read() == c2.read()
+    assert replay == c2.read()
