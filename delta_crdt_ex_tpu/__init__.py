@@ -51,7 +51,7 @@ __all__ = [
 # init. Resolving the public surface on first attribute access keeps
 # `import delta_crdt_ex_tpu` backend-free.
 _EXPORTS = {
-    "AWLWWMap": ("delta_crdt_ex_tpu.models.aw_lww_map", "AWLWWMap"),
+    "AWLWWMap": ("delta_crdt_ex_tpu.models.binned_map", "BinnedAWLWWMap"),
     "DeltaCrdt": ("delta_crdt_ex_tpu.api", "DeltaCrdt"),
     "MemoryStorage": ("delta_crdt_ex_tpu.runtime.storage", "MemoryStorage"),
     "Storage": ("delta_crdt_ex_tpu.runtime.storage", "Storage"),

@@ -36,7 +36,7 @@ from __future__ import annotations
 
 from typing import Any
 
-from delta_crdt_ex_tpu.models.aw_lww_map import AWLWWMap
+from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap as AWLWWMap
 from delta_crdt_ex_tpu.runtime.replica import Replica
 
 DEFAULT_SYNC_INTERVAL = 0.2  # seconds (reference: 200 ms, delta_crdt.ex:31)

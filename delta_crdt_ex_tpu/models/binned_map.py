@@ -1,0 +1,153 @@
+"""BinnedAWLWWMap — the AWLWWMap model over the bucket-binned store.
+
+The TPU-native counterpart of the reference's pluggable ``crdt_module``
+(``DeltaCrdt.AWLWWMap``, ``aw_lww_map.ex``), backed by the row-local
+kernels of :mod:`delta_crdt_ex_tpu.ops.binned` (see
+:mod:`delta_crdt_ex_tpu.models.binned` for the layout). The replica
+runtime is generic over this class — the reference's ``crdt_module``
+indirection (``causal_crdt.ex:50,72,189,339,384``).
+
+Semantic contract (SURVEY §7 non-negotiables) is identical to the flat
+model: add-wins observed-remove; LWW by (ts, writer gid, ctr); causal
+join ``(s1∩s2) ∪ (s1∖c2) ∪ (s2∖c1)``; context union = per-replica max.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+
+from delta_crdt_ex_tpu.models.binned import BinnedStore
+from delta_crdt_ex_tpu.ops import binned as binned_ops
+from delta_crdt_ex_tpu.ops.apply import OP_ADD, OP_CLEAR, OP_PAD, OP_REMOVE
+
+jit_row_apply = jax.jit(binned_ops.row_apply)
+jit_clear_all = jax.jit(binned_ops.clear_all)
+jit_merge_slice = jax.jit(binned_ops.merge_slice, static_argnames=("kill_budget",))
+jit_extract_rows = jax.jit(binned_ops.extract_rows)
+jit_winners_for_keys = jax.jit(binned_ops.winners_for_keys)
+jit_winner_rows = jax.jit(binned_ops.winner_rows)
+jit_compact_rows = jax.jit(binned_ops.compact_rows)
+jit_tree_from_leaves = jax.jit(binned_ops.tree_from_leaves)
+
+
+def _pow2(n: int, floor: int = 1) -> int:
+    c = floor
+    while c < n:
+        c *= 2
+    return c
+
+
+class GroupedBatch:
+    """A local mutation batch grouped by bucket row for :func:`row_apply`.
+
+    ``index`` maps each original batch position to its (row, col) in the
+    grouped arrays so callers can recover per-op results (assigned dot
+    counters). Shapes are padded to power-of-two tiers to bound kernel
+    recompiles.
+    """
+
+    def __init__(self, rows, op, key, valh, ts, index):
+        self.rows = rows
+        self.op = op
+        self.key = key
+        self.valh = valh
+        self.ts = ts
+        self.index = index
+
+
+def group_batch(num_buckets: int, op, key, valh, ts) -> GroupedBatch:
+    """Group flat batch arrays (numpy, batch order) by bucket row.
+
+    Ops for the same key keep their relative order inside a row, which is
+    all the sequential batch semantics need (ops on different keys
+    commute; see :func:`delta_crdt_ex_tpu.ops.binned.row_apply`).
+    ``clear`` must be split out by the caller (``clear_all``).
+    """
+    n = len(op)
+    bucket = (key & np.uint64(num_buckets - 1)).astype(np.int64)
+    order: dict[int, int] = {}
+    cols = np.zeros(n, np.int64)
+    counts: dict[int, int] = {}
+    urow_of = np.zeros(n, np.int64)
+    for i in range(n):
+        b = int(bucket[i])
+        if b not in order:
+            order[b] = len(order)
+            counts[b] = 0
+        urow_of[i] = order[b]
+        cols[i] = counts[b]
+        counts[b] += 1
+    u = _pow2(max(len(order), 1))
+    m = _pow2(max(counts.values(), default=1))
+    rows = np.full(u, -1, np.int32)
+    for b, r in order.items():
+        rows[r] = b
+    g_op = np.full((u, m), OP_PAD, np.int32)
+    g_key = np.zeros((u, m), np.uint64)
+    g_valh = np.zeros((u, m), np.uint32)
+    g_ts = np.zeros((u, m), np.int64)
+    g_op[urow_of, cols] = op
+    g_key[urow_of, cols] = key
+    g_valh[urow_of, cols] = valh
+    g_ts[urow_of, cols] = ts
+    return GroupedBatch(rows, g_op, g_key, g_valh, g_ts, (urow_of, cols))
+
+
+def merge_into(state: BinnedStore, sl, kill_budget: int = 16, on_grow=None):
+    """Merge a :class:`~delta_crdt_ex_tpu.ops.binned.RowSlice` into
+    ``state``, handling every ``need_*`` escape hatch: grow the gid table,
+    raise the kill-budget tier, compact holes, grow the bin tier. Returns
+    ``(new_state, last_result)``. ``on_grow(state)`` fires after each
+    capacity growth (telemetry hook).
+
+    Holes cannot reappear between a compact and the next retry (only
+    successful merges create them), so after one compact further fill
+    overflows go straight to bin growth.
+    """
+    compacted = False
+    while True:
+        res = jit_merge_slice(state, sl, kill_budget=kill_budget)
+        if bool(res.ok):
+            return res.state, res
+        if bool(res.need_gid_grow):
+            state = state.grow(replica_capacity=state.replica_capacity * 2)
+            if on_grow:
+                on_grow(state)
+        if bool(res.need_kill_tier):
+            kill_budget = min(kill_budget * 4, int(sl.rows.shape[0]))
+        if bool(res.need_fill_compact):
+            if not compacted:
+                state = jit_compact_rows(state)
+                compacted = True
+            else:
+                state = state.grow(bin_capacity=state.bin_capacity * 2)
+                if on_grow:
+                    on_grow(state)
+
+
+class BinnedAWLWWMap:
+    """Model class: op vocabulary + kernels over :class:`BinnedStore`."""
+
+    #: mutation name → (op code, arity of user args)
+    OPS = {
+        "add": (OP_ADD, 2),  # add(key, value)    aw_lww_map.ex:99
+        "remove": (OP_REMOVE, 1),  # remove(key)  aw_lww_map.ex:133
+        "clear": (OP_CLEAR, 0),  # clear()        aw_lww_map.ex:148
+    }
+
+    Store = BinnedStore
+    new = staticmethod(BinnedStore.new)
+    group_batch = staticmethod(group_batch)
+    row_apply = staticmethod(jit_row_apply)
+    clear_all = staticmethod(jit_clear_all)
+    merge_slice = staticmethod(jit_merge_slice)
+    extract_rows = staticmethod(jit_extract_rows)
+    winners_for_keys = staticmethod(jit_winners_for_keys)
+    winner_rows = staticmethod(jit_winner_rows)
+    compact_rows = staticmethod(jit_compact_rows)
+    tree_from_leaves = staticmethod(jit_tree_from_leaves)
+    merge_into = staticmethod(merge_into)
+    RowSlice = binned_ops.RowSlice

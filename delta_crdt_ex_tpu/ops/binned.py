@@ -1,0 +1,579 @@
+"""Row-local kernels over the bucket-binned dot store.
+
+Every kernel here touches only the rows named by its inputs — dense
+gathers, vector math along the bin axis, small element scatters — so the
+cost model matches the reference's O(touched keys) merges
+(``update_state_with_delta``, ``causal_crdt.ex:383-404``) instead of
+O(state capacity). See :mod:`delta_crdt_ex_tpu.models.binned` for the
+layout and its maintained invariants (``fill``, ``amin``, ``leaf``,
+``ehash``).
+
+Kernels:
+
+- :func:`row_apply` — local mutation batch, grouped by bucket row
+  (sequential batch semantics; the reference applies one op per mailbox
+  message, ``causal_crdt.ex:337-342``).
+- :func:`merge_slice` — the anti-entropy merge: join a received bucket
+  slice (entries + context rows of exactly the synced buckets). Insert
+  work is O(slice); the kill pass runs only on rows flagged by the
+  ``amin`` pruning test, within a static budget ``KB`` (exceeding it
+  returns ``ok=False`` and the host retries a larger tier).
+- :func:`winners_for_keys` / :func:`winner_rows` — LWW read resolution
+  (``AWLWWMap.read``, ``aw_lww_map.ex:211-224``).
+- :func:`extract_rows` — the sync data plane: gather rows + context rows
+  (``Map.take`` + ``dots``, ``causal_crdt.ex:115-119``).
+- :func:`compact_rows` — full repack (hole reclamation) + invariant
+  rebuild; :func:`clear_all` — kill every observed dot.
+- :func:`tree_from_leaves` — digest-tree levels above the maintained
+  leaf digests (``MerkleMap.update_hashes``, ``causal_crdt.ex:254``).
+
+Dot semantics are unchanged from the flat kernels: add-wins observed
+remove, per-key LWW by (ts, writer gid, ctr), causal join
+``(s1∩s2) ∪ (s1∖c2) ∪ (s2∖c1)`` per key, context union = per-replica max
+(``aw_lww_map.ex:99-209``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from delta_crdt_ex_tpu.models.binned import BinnedStore, U32_MAX
+from delta_crdt_ex_tpu.ops.apply import OP_ADD, OP_REMOVE
+from delta_crdt_ex_tpu.ops.dots import encode_dot, merge_gid_tables
+
+_M1 = jnp.uint64(0xBF58476D1CE4E5B9)
+_M2 = jnp.uint64(0x94D049BB133111EB)
+_P1 = jnp.uint32(0x85EBCA6B)
+_P2 = jnp.uint32(0xC2B2AE35)
+
+
+def _mix64(x: jnp.ndarray) -> jnp.ndarray:
+    x = (x ^ (x >> jnp.uint64(30))) * _M1
+    x = (x ^ (x >> jnp.uint64(27))) * _M2
+    return x ^ (x >> jnp.uint64(31))
+
+
+def _mix32(x: jnp.ndarray) -> jnp.ndarray:
+    x = (x ^ (x >> jnp.uint32(16))) * _P1
+    x = (x ^ (x >> jnp.uint32(13))) * _P2
+    return x ^ (x >> jnp.uint32(16))
+
+
+def entry_hash(key, gid, ctr, ts, valh) -> jnp.ndarray:
+    """uint32 content hash of an entry — replica-independent (uses the
+    writer's GLOBAL id), covering the internal dot representation so
+    same-value/different-dots replicas still diff (the MerkleMap property,
+    ``causal_crdt_test.exs:154-171``)."""
+    h = _mix64(
+        key
+        ^ _mix64(gid ^ ctr.astype(jnp.uint64))
+        ^ _mix64(ts.astype(jnp.uint64) ^ (valh.astype(jnp.uint64) << jnp.uint64(32)))
+    )
+    return (h ^ (h >> jnp.uint64(32))).astype(jnp.uint32)
+
+
+def tree_from_leaves(leaf: jnp.ndarray) -> list[jnp.ndarray]:
+    """Digest-tree levels from the maintained leaf digests, root first:
+    ``[u32[1], u32[2], …, u32[L]]`` (level combine identical to the flat
+    :mod:`~delta_crdt_ex_tpu.ops.hashtree` so the sync walk is shared)."""
+    levels = [leaf]
+    while levels[-1].shape[0] > 1:
+        cur = levels[-1].reshape(-1, 2)
+        left = _mix32(cur[:, 0] ^ _P1)
+        right = _mix32(cur[:, 1] ^ _P2)
+        levels.append(left + (right << jnp.uint32(1)) + jnp.uint32(0x9E3779B9))
+    return levels[::-1]
+
+
+def _row_amin(node, ctr, alive, u, r):
+    """uint32[U, R] min alive counter per (row, writer slot)."""
+    uu = jnp.broadcast_to(jnp.arange(u)[:, None], node.shape)
+    return (
+        jnp.full((u, r), U32_MAX, jnp.uint32)
+        .at[uu, node]
+        .min(jnp.where(alive, ctr, U32_MAX))
+    )
+
+
+def _row_compact(cols: dict, alive: jnp.ndarray):
+    """Stable-pack alive entries to the front of each row; returns
+    (packed cols, packed alive, fill per row)."""
+    order = jnp.argsort(~alive, axis=1, stable=True)
+    packed = {c: jnp.take_along_axis(v, order, axis=1) for c, v in cols.items()}
+    alive_p = jnp.take_along_axis(alive, order, axis=1)
+    fill = jnp.sum(alive_p, axis=1, dtype=jnp.int32)
+    return packed, alive_p, fill
+
+
+_ROW_COLS = ("key", "valh", "ts", "node", "ctr", "ehash")
+
+
+def _gather_rows(state: BinnedStore, rows_safe: jnp.ndarray) -> dict:
+    return {c: getattr(state, c)[rows_safe] for c in _ROW_COLS}
+
+
+# ---------------------------------------------------------------------------
+# local mutation batch
+
+
+class RowApplyResult(NamedTuple):
+    state: BinnedStore
+    ok: jnp.ndarray  # bool: every touched row had bin space
+    ctr_assigned: jnp.ndarray  # uint32[U, M] dot counter per add op
+    n_keys_changed: jnp.ndarray  # int32 (telemetry keys_updated_count)
+
+
+def row_apply(
+    state: BinnedStore,
+    self_slot: jnp.ndarray,  # int32 scalar
+    rows: jnp.ndarray,  # int32[U] unique bucket rows (-1 = padding)
+    op: jnp.ndarray,  # int32[U, M] ops per row, batch order (OP_PAD pads)
+    key: jnp.ndarray,  # uint64[U, M]
+    valh: jnp.ndarray,  # uint32[U, M]
+    ts: jnp.ndarray,  # int64[U, M]
+) -> RowApplyResult:
+    """Apply a bucket-grouped local mutation batch with sequential
+    semantics: within a row, a later op shadows earlier same-key ops, and
+    every pre-batch same-key entry dies (a local op observes all local
+    dots — the remove-delta half of ``AWLWWMap.add``, ``aw_lww_map.ex:
+    99-112``). ``clear`` is handled by :func:`clear_all`, not here."""
+    L = state.num_buckets
+    B = state.bin_capacity
+    R = state.replica_capacity
+    u, m = op.shape
+
+    valid = rows >= 0
+    rows_safe = jnp.where(valid, rows, L)  # out-of-range: gathers clip, scatters drop
+    rows_clip = jnp.clip(rows_safe, 0, L - 1)
+    g = _gather_rows(state, rows_clip)
+    galive = state.alive[rows_clip] & valid[:, None]
+
+    is_add = (op == OP_ADD) & valid[:, None]
+    is_touch = is_add | ((op == OP_REMOVE) & valid[:, None])
+
+    # fresh dot counters, one sequence per replica (Dots.next_dot)
+    base = state.own_counter(self_slot)
+    add_rank = jnp.cumsum(is_add.reshape(-1).astype(jnp.uint32)).reshape(u, m)
+    ctr_assigned = base + add_rank
+
+    # batch-internal shadowing: a later same-key touch kills op (u, m)
+    later = jnp.triu(jnp.ones((m, m), bool), 1)
+    key_eq = key[:, :, None] == key[:, None, :]  # [U, M, M] (m, m')
+    shadowed = jnp.any(key_eq & later[None] & is_touch[:, None, :], axis=2)
+    ins = is_add & ~shadowed
+
+    # pre-batch kills: every alive entry whose key any batch op touches
+    hit = jnp.any(
+        (g["key"][:, :, None] == key[:, None, :]) & is_touch[:, None, :], axis=2
+    )
+    killed = galive & hit
+    alive1 = galive & ~hit
+
+    # insert into the lowest free slots of each row
+    free = ~alive1
+    free_rank = jnp.cumsum(free.astype(jnp.int32), axis=1) - 1
+    uu_b = jnp.broadcast_to(jnp.arange(u)[:, None], (u, B))
+    slot_of_rank = (
+        jnp.full((u, B), B, jnp.int32)
+        .at[uu_b, jnp.where(free, free_rank, B)]
+        .set(jnp.broadcast_to(jnp.arange(B, dtype=jnp.int32), (u, B)), mode="drop")
+    )
+    ins_rank = jnp.cumsum(ins.astype(jnp.int32), axis=1) - 1
+    n_ins = jnp.sum(ins.astype(jnp.int32), axis=1)
+    ok = jnp.all(n_ins <= jnp.sum(free.astype(jnp.int32), axis=1))
+    tgt_b = jnp.where(
+        ins,
+        jnp.take_along_axis(slot_of_rank, jnp.clip(ins_rank, 0, B - 1), axis=1),
+        B,
+    )
+
+    gid_self = state.ctx_gid[self_slot]
+    eh = entry_hash(key, gid_self, ctr_assigned, ts, valh)
+    uu_m = jnp.broadcast_to(jnp.arange(u)[:, None], (u, m))
+    node_new = jnp.full((u, m), self_slot, jnp.int32)
+
+    def put(col, vals):
+        return col.at[uu_m, tgt_b].set(vals, mode="drop")
+
+    cols = {
+        "key": put(g["key"], key),
+        "valh": put(g["valh"], valh),
+        "ts": put(g["ts"], ts),
+        "node": put(g["node"], node_new),
+        "ctr": put(g["ctr"], ctr_assigned),
+        "ehash": put(g["ehash"], eh),
+    }
+    alive2 = alive1.at[uu_m, tgt_b].set(True, mode="drop")
+
+    # repack rows (free in-row compaction: rows are rewritten anyway)
+    packed, alive_p, fill_rows = _row_compact(cols, alive2)
+
+    amin_rows = _row_amin(packed["node"], packed["ctr"], alive_p, u, R)
+    leaf_rows = jnp.sum(
+        jnp.where(alive_p, packed["ehash"], jnp.uint32(0)), axis=1, dtype=jnp.uint32
+    )
+    own_max = jnp.max(jnp.where(ins, ctr_assigned, jnp.uint32(0)), axis=1)
+
+    new_state = BinnedStore(
+        **{c: getattr(state, c).at[rows_safe].set(packed[c], mode="drop") for c in _ROW_COLS},
+        alive=state.alive.at[rows_safe].set(alive_p, mode="drop"),
+        fill=state.fill.at[rows_safe].set(fill_rows, mode="drop"),
+        amin=state.amin.at[rows_safe].set(amin_rows, mode="drop"),
+        leaf=state.leaf.at[rows_safe].set(leaf_rows, mode="drop"),
+        ctx_gid=state.ctx_gid,
+        ctx_max=state.ctx_max.at[rows_safe, self_slot].max(own_max, mode="drop"),
+    )
+
+    # telemetry: distinct keys whose dot store changed (first-occurrence
+    # op marks; key sets of distinct rows are disjoint)
+    earlier = jnp.tril(jnp.ones((m, m), bool), -1)
+    first_occ = ~jnp.any(key_eq & earlier[None] & is_touch[:, None, :], axis=2)
+    killed_any = jnp.any(
+        (key[:, :, None] == g["key"][:, None, :]) & galive[:, None, :], axis=2
+    )
+    changed = is_touch & first_occ & (ins | killed_any)
+    n_keys_changed = jnp.sum(changed.astype(jnp.int32))
+
+    return RowApplyResult(new_state, ok, ctr_assigned, n_keys_changed)
+
+
+def clear_all(state: BinnedStore) -> BinnedStore:
+    """Kill every observed dot (``AWLWWMap.clear``, ``aw_lww_map.ex:
+    148-150``): entries die, the context stays — so the clear propagates
+    as coverage and unobserved remote dots survive."""
+    return BinnedStore(
+        key=state.key,
+        valh=state.valh,
+        ts=state.ts,
+        node=state.node,
+        ctr=state.ctr,
+        alive=jnp.zeros_like(state.alive),
+        ehash=state.ehash,
+        fill=jnp.zeros_like(state.fill),
+        amin=jnp.full_like(state.amin, U32_MAX),
+        leaf=jnp.zeros_like(state.leaf),
+        ctx_gid=state.ctx_gid,
+        ctx_max=state.ctx_max,
+    )
+
+
+# ---------------------------------------------------------------------------
+# anti-entropy merge
+
+
+class RowSlice(NamedTuple):
+    """Wire format of the sync data plane: gathered rows of the sender's
+    store plus the matching context rows — the bucket-atomic analog of the
+    reference's ``%{crdt | dots: …, value: Map.take(…)}`` diff payload
+    (``causal_crdt.ex:115-119``)."""
+
+    rows: jnp.ndarray  # int32[U] bucket indices (-1 = padding)
+    key: jnp.ndarray  # uint64[U, S]
+    valh: jnp.ndarray  # uint32[U, S]
+    ts: jnp.ndarray  # int64[U, S]
+    node: jnp.ndarray  # int32[U, S] (sender-local slots)
+    ctr: jnp.ndarray  # uint32[U, S]
+    alive: jnp.ndarray  # bool[U, S]
+    ctx_rows: jnp.ndarray  # uint32[U, Rr]
+    ctx_gid: jnp.ndarray  # uint64[Rr]
+
+
+def extract_rows(state: BinnedStore, rows: jnp.ndarray) -> RowSlice:
+    """Gather the slice for a set of bucket rows (-1 pads)."""
+    L = state.num_buckets
+    valid = rows >= 0
+    rows_clip = jnp.clip(rows, 0, L - 1)
+    v = valid[:, None]
+    g = _gather_rows(state, rows_clip)
+    return RowSlice(
+        rows=rows,
+        key=g["key"],
+        valh=g["valh"],
+        ts=g["ts"],
+        node=g["node"],
+        ctr=g["ctr"],
+        alive=state.alive[rows_clip] & v,
+        ctx_rows=state.ctx_max[rows_clip] * valid[:, None].astype(jnp.uint32),
+        ctx_gid=state.ctx_gid,
+    )
+
+
+class MergeResult(NamedTuple):
+    state: BinnedStore
+    ok: jnp.ndarray  # bool: result valid (budgets sufficed)
+    need_gid_grow: jnp.ndarray  # bool: unknown writer gids overflowed R
+    need_kill_tier: jnp.ndarray  # bool: flagged rows exceeded the kill budget
+    need_fill_compact: jnp.ndarray  # bool: some row ran out of bin space
+    n_inserted: jnp.ndarray  # int32
+    n_killed: jnp.ndarray  # int32
+
+
+def merge_slice(state: BinnedStore, sl: RowSlice, kill_budget: int) -> MergeResult:
+    """Join a received bucket slice into the local state — O(slice) plus
+    O(kill_budget · B) for the pruned kill pass.
+
+    Per synced bucket the reference join applies (``aw_lww_map.ex:
+    153-209``):
+      - insert remote entries not covered by the local context (s2 ∖ c1);
+      - kill local entries covered by the remote context and absent from
+        the remote entries (survivors = (s1∩s2) ∪ (s1∖c2));
+      - context union (per-replica max).
+    The kill pass gathers only rows where ``amin`` proves a kill is
+    possible; ``kill_budget`` rows at most (static tier), else
+    ``ok=False`` and the host retries with a bigger tier.
+    """
+    L = state.num_buckets
+    B = state.bin_capacity
+    R = state.replica_capacity
+    u, s = sl.key.shape
+
+    valid = sl.rows >= 0
+    rows_safe = jnp.where(valid, sl.rows, L)
+    rows_clip = jnp.clip(rows_safe, 0, L - 1)
+
+    gids = merge_gid_tables(state.ctx_gid, sl.ctx_gid)
+
+    # remote context rows in local slot indexing: [U, R]
+    uu_r = jnp.broadcast_to(jnp.arange(u)[:, None], sl.ctx_rows.shape)
+    remap_cols = jnp.broadcast_to(gids.remap[None, :], sl.ctx_rows.shape)
+    rdense = (
+        jnp.zeros((u, R), jnp.uint32)
+        .at[uu_r, jnp.where(remap_cols >= 0, remap_cols, R)]
+        .max(sl.ctx_rows, mode="drop")
+    )
+
+    # --- insert pass (s2 ∖ c1) -------------------------------------------
+    ln = gids.remap[jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1)]  # [U, S]
+    ln_clip = jnp.clip(ln, 0, R - 1)
+    local_ctx_rows = state.ctx_max[rows_clip]  # [U, R]
+    covered_local = (
+        jnp.take_along_axis(local_ctx_rows, ln_clip.astype(jnp.int32), axis=1)
+        >= sl.ctr
+    )
+    ins = sl.alive & valid[:, None] & ~covered_local & (ln >= 0)
+
+    ins_rank = jnp.cumsum(ins.astype(jnp.int32), axis=1) - 1
+    n_ins_row = jnp.sum(ins, axis=1, dtype=jnp.int32)
+    fill_rows = state.fill[rows_clip]
+    need_fill_compact = jnp.any(valid & (fill_rows + n_ins_row > B))
+    pos = fill_rows[:, None] + ins_rank  # [U, S] target bin slot
+
+    # overflowing rows (pos >= B) must not clip into valid slots — drop;
+    # ok=False discards the whole result anyway
+    flat = jnp.where(ins & (pos < B), rows_clip[:, None] * B + jnp.clip(pos, 0, B - 1), L * B)
+    gid_of_entry = sl.ctx_gid[jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1)]
+    eh_ins = entry_hash(sl.key, gid_of_entry, sl.ctr, sl.ts, sl.valh)
+
+    def put(col, vals):
+        return (
+            col.reshape(-1)
+            .at[flat.reshape(-1)]
+            .set(vals.reshape(-1), mode="drop")
+            .reshape(L, B)
+        )
+
+    key2 = put(state.key, sl.key)
+    valh2 = put(state.valh, sl.valh)
+    ts2 = put(state.ts, sl.ts)
+    node2 = put(state.node, ln_clip.astype(jnp.int32))
+    ctr2 = put(state.ctr, sl.ctr)
+    ehash2 = put(state.ehash, eh_ins)
+    alive2 = put(state.alive, ins)
+    fill2 = state.fill.at[rows_safe].add(n_ins_row, mode="drop")
+    amin2 = state.amin.at[rows_clip[:, None], ln_clip].min(
+        jnp.where(ins, sl.ctr, U32_MAX), mode="drop"
+    )
+    leaf_add = jnp.sum(jnp.where(ins, eh_ins, jnp.uint32(0)), axis=1, dtype=jnp.uint32)
+    leaf2 = state.leaf.at[rows_safe].add(leaf_add, mode="drop")
+    ctx2 = state.ctx_max.at[rows_safe].max(rdense, mode="drop")
+    n_inserted = jnp.sum(ins.astype(jnp.int32))
+
+    # --- kill pass ((s1∩s2) ∪ (s1∖c2)), pruned by amin --------------------
+    # a remote context row can only kill a local dot if it reaches that
+    # (bucket, writer)'s minimum alive counter — all computed on the
+    # PRE-merge state, as the join semantics demand
+    amin_rows = state.amin[rows_clip]
+    flagged = valid & jnp.any(rdense >= amin_rows, axis=1)
+    n_flagged = jnp.sum(flagged.astype(jnp.int32))
+    need_kill_tier = n_flagged > kill_budget
+
+    order = jnp.argsort(~flagged, stable=True)[:kill_budget]  # flagged first
+    kb = order.shape[0]  # = min(kill_budget, U)
+    k_valid = flagged[order]  # [KB]
+    k_rows = jnp.where(k_valid, rows_clip[order], L)
+    k_rows_clip = jnp.clip(k_rows, 0, L - 1)
+
+    # local dots of the flagged rows — NOTE: read through the post-insert
+    # arrays; inserted entries sit at slots >= fill and carry fresh remote
+    # dots (present in the slice by construction), so they survive their
+    # own coverage test via the presence check below
+    l_node = node2[k_rows_clip]
+    l_ctr = ctr2[k_rows_clip]
+    l_alive = alive2[k_rows_clip] & k_valid[:, None]
+    l_ehash = ehash2[k_rows_clip]
+
+    k_rdense = rdense[order]  # [KB, R]
+    covered = (
+        jnp.take_along_axis(k_rdense, l_node.astype(jnp.int32), axis=1) >= l_ctr
+    )
+    # presence among remote slice dots of the same rows: [KB, B] vs [KB, S]
+    r_node = ln_clip[order]
+    r_ctr = sl.ctr[order]
+    r_alive = sl.alive[order] & k_valid[:, None]
+    l_dot = encode_dot(l_node, l_ctr)
+    r_dot = jnp.where(r_alive, encode_dot(r_node, r_ctr), jnp.uint64(0))
+    present = jnp.any(l_dot[:, :, None] == r_dot[:, None, :], axis=2)
+
+    die = l_alive & covered & ~present
+    alive3 = alive2.at[k_rows].set(l_alive & ~die, mode="drop")
+    # wrapping subtract of dead hashes from the leaf digests
+    leaf_sub = jnp.sum(jnp.where(die, l_ehash, jnp.uint32(0)), axis=1, dtype=jnp.uint32)
+    leaf3 = leaf2.at[k_rows].add(~leaf_sub + jnp.uint32(1), mode="drop")
+    amin_k = _row_amin(l_node, l_ctr, l_alive & ~die, kb, R)
+    amin3 = amin2.at[k_rows].set(amin_k, mode="drop")
+    n_killed = jnp.sum(die.astype(jnp.int32))
+
+    ok = ~(gids.overflow | need_kill_tier | need_fill_compact)
+    new_state = BinnedStore(
+        key=key2,
+        valh=valh2,
+        ts=ts2,
+        node=node2,
+        ctr=ctr2,
+        alive=alive3,
+        ehash=ehash2,
+        fill=fill2,
+        amin=amin3,
+        leaf=leaf3,
+        ctx_gid=gids.ctx_gid,
+        ctx_max=ctx2,
+    )
+    return MergeResult(
+        new_state,
+        ok,
+        gids.overflow,
+        need_kill_tier,
+        need_fill_compact,
+        n_inserted,
+        n_killed,
+    )
+
+
+# ---------------------------------------------------------------------------
+# reads
+
+
+class KeyWinners(NamedTuple):
+    found: jnp.ndarray  # bool[K]
+    gid: jnp.ndarray  # uint64[K] winner's writer gid
+    ctr: jnp.ndarray  # uint32[K]
+    valh: jnp.ndarray  # uint32[K]
+    ts: jnp.ndarray  # int64[K]
+
+
+def _lww_rank(ts, gid, ctr, alive):
+    """Lexicographic (ts, gid, ctr) LWW order as a sortable tuple; dead
+    entries rank below everything."""
+    neg = jnp.int64(-(2**62))
+    return (
+        jnp.where(alive, ts, neg),
+        jnp.where(alive, gid, jnp.uint64(0)),
+        jnp.where(alive, ctr, jnp.uint32(0)),
+    )
+
+
+def _argmax_lww(ts, gid, ctr, alive):
+    """int32[..., 1] index of the lexicographic (ts, gid, ctr) maximum
+    along the last axis: narrow the candidate mask one component at a
+    time, then take the first index of the final mask."""
+    t, g, c = _lww_rank(ts, gid, ctr, alive)
+    m1 = t == jnp.max(t, axis=-1, keepdims=True)
+    g1 = jnp.where(m1, g, jnp.uint64(0))
+    m2 = m1 & (g1 == jnp.max(g1, axis=-1, keepdims=True))
+    c1 = jnp.where(m2, c, jnp.uint32(0))
+    m3 = m2 & (c1 == jnp.max(c1, axis=-1, keepdims=True))
+    return jnp.argmax(m3, axis=-1, keepdims=True)
+
+
+def winners_for_keys(state: BinnedStore, khash: jnp.ndarray) -> KeyWinners:
+    """LWW winner per queried key hash (``AWLWWMap.read/2``,
+    ``aw_lww_map.ex:218-224``)."""
+    rows = state.bucket_of(khash)
+    g_ts = state.ts[rows]
+    g_key = state.key[rows]
+    g_alive = state.alive[rows] & (g_key == khash[:, None])
+    g_gid = state.ctx_gid[state.node[rows]]
+    g_ctr = state.ctr[rows]
+    best = _argmax_lww(g_ts, g_gid, g_ctr, g_alive)
+    take = lambda a: jnp.take_along_axis(a, best, axis=1)[:, 0]
+    return KeyWinners(
+        found=take(g_alive),
+        gid=take(g_gid),
+        ctr=take(g_ctr),
+        valh=take(state.valh[rows]),
+        ts=take(g_ts),
+    )
+
+
+class RowWinners(NamedTuple):
+    win: jnp.ndarray  # bool[U, B]: entry is its key's LWW winner
+    key: jnp.ndarray  # uint64[U, B]
+    gid: jnp.ndarray  # uint64[U, B]
+    ctr: jnp.ndarray  # uint32[U, B]
+    valh: jnp.ndarray  # uint32[U, B]
+    ts: jnp.ndarray  # int64[U, B]
+
+
+def winner_rows(state: BinnedStore, rows: jnp.ndarray) -> RowWinners:
+    """Per-key LWW winners within the given bucket rows (full-map read =
+    all rows, chunked by the host). An entry wins iff no other alive
+    same-key entry in its row ranks higher (keys never span rows)."""
+    L = state.num_buckets
+    valid = rows >= 0
+    rows_clip = jnp.clip(rows, 0, L - 1)
+    key = state.key[rows_clip]
+    ts = state.ts[rows_clip]
+    ctr = state.ctr[rows_clip]
+    gid = state.ctx_gid[state.node[rows_clip]]
+    alive = state.alive[rows_clip] & valid[:, None]
+
+    t, g, c = _lww_rank(ts, gid, ctr, alive)
+    same = (key[:, :, None] == key[:, None, :]) & alive[:, :, None] & alive[:, None, :]
+    beats = (t[:, None, :] > t[:, :, None]) | (
+        (t[:, None, :] == t[:, :, None])
+        & (
+            (g[:, None, :] > g[:, :, None])
+            | ((g[:, None, :] == g[:, :, None]) & (c[:, None, :] > c[:, :, None]))
+        )
+    )
+    win = alive & ~jnp.any(same & beats, axis=2)
+    return RowWinners(win, key, gid, ctr, state.valh[rows_clip], ts)
+
+
+# ---------------------------------------------------------------------------
+# maintenance
+
+
+def compact_rows(state: BinnedStore) -> BinnedStore:
+    """Full repack: reclaim holes left by merge kills, rebuild every
+    maintained invariant. One dense pass; host calls it when a merge
+    reports ``need_fill_compact``."""
+    L = state.num_buckets
+    R = state.replica_capacity
+    cols = {c: getattr(state, c) for c in _ROW_COLS}
+    packed, alive_p, fill = _row_compact(cols, state.alive)
+    amin = _row_amin(packed["node"], packed["ctr"], alive_p, L, R)
+    leaf = jnp.sum(
+        jnp.where(alive_p, packed["ehash"], jnp.uint32(0)), axis=1, dtype=jnp.uint32
+    )
+    return BinnedStore(
+        **packed,
+        alive=alive_p,
+        fill=fill,
+        amin=amin,
+        leaf=leaf,
+        ctx_gid=state.ctx_gid,
+        ctx_max=state.ctx_max,
+    )

@@ -89,3 +89,42 @@ def merge_contexts(
 def encode_dot(node: jnp.ndarray, ctr: jnp.ndarray) -> jnp.ndarray:
     """Pack a (local-slot, counter) dot into one u64 sort/search key."""
     return (node.astype(jnp.uint64) << jnp.uint64(32)) | ctr.astype(jnp.uint64)
+
+
+class MergedGids(NamedTuple):
+    ctx_gid: jnp.ndarray  # uint64[R]  merged slot table (local slots preserved)
+    remap: jnp.ndarray  # int32[Rr]  remote slot → local slot (-1 for empty)
+    overflow: jnp.ndarray  # bool       not enough free local slots for new gids
+
+
+def merge_gid_tables(gid_l: jnp.ndarray, gid_r: jnp.ndarray) -> MergedGids:
+    """Merge the remote gid slot table into the local one — the R-sized
+    prefix of :func:`merge_contexts`, for callers that handle context rows
+    themselves (O(delta) merges must not touch the full ``[L, R]`` table)."""
+    r_local = gid_l.shape[0]
+
+    occupied_r = gid_r != 0
+    eq = (gid_l[:, None] == gid_r[None, :]) & occupied_r[None, :]
+    has_match = jnp.any(eq, axis=0)
+    match_idx = jnp.argmax(eq, axis=0).astype(jnp.int32)
+
+    is_new = occupied_r & ~has_match
+    free = gid_l == 0
+    free_rank = jnp.cumsum(free.astype(jnp.int32)) - 1
+    slot_of_rank = (
+        jnp.full(r_local, r_local, jnp.int32)
+        .at[jnp.where(free, free_rank, r_local)]
+        .set(jnp.arange(r_local, dtype=jnp.int32), mode="drop")
+    )
+    new_rank = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+    overflow = jnp.sum(is_new.astype(jnp.int32)) > jnp.sum(free.astype(jnp.int32))
+
+    new_slot = slot_of_rank[jnp.clip(new_rank, 0, r_local - 1)]
+    target = jnp.where(is_new, new_slot, match_idx)
+    target = jnp.where(occupied_r, target, r_local)
+
+    ctx_gid = gid_l.at[target].set(gid_r, mode="drop")
+    # un-placeable new gids (overflow) map to -1 like empties, keeping the
+    # "-1 = no local slot" contract callers guard on
+    remap = jnp.where(occupied_r & (target < r_local), target, -1).astype(jnp.int32)
+    return MergedGids(ctx_gid, remap, overflow)

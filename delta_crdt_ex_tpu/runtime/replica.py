@@ -3,8 +3,9 @@
 TPU-native counterpart of ``DeltaCrdt.CausalCrdt`` (``causal_crdt.ex``):
 where the reference serialises every state transition through a GenServer
 mailbox, this driver serialises through a lock and issues **batched,
-jit-compiled kernel calls** against the device state. Capabilities map
-1:1 (SURVEY §2.2):
+jit-compiled kernel calls** against the device state (the bucket-binned
+engine, :mod:`delta_crdt_ex_tpu.models.binned`). Capabilities map 1:1
+(SURVEY §2.2):
 
 - mutate (sync) / mutate_async → queued mutation batch, flushed before
   any read/sync (mailbox-order semantics of ``handle_call``/``handle_cast``,
@@ -19,8 +20,8 @@ jit-compiled kernel calls** against the device state. Capabilities map
   continuity, ``causal_crdt.ex:220-231``);
 - telemetry ``(delta_crdt, sync, done)`` on every merge (``:396-398``).
 
-Capacity is tiered: kernels signal overflow via ``ok`` flags and the
-driver grows the state (or slice buffers) and retries — the only
+Capacity is tiered: kernels signal overflow via ``ok``/``need_*`` flags
+and the driver compacts or grows a tier and retries — the only
 data-dependent control flow, and it lives on the host.
 """
 
@@ -42,8 +43,8 @@ from delta_crdt_ex_tpu.utils.hashing import (
     value_hash32,
     value_hash32_batch,
 )
-from delta_crdt_ex_tpu.models.aw_lww_map import AWLWWMap
-from delta_crdt_ex_tpu.models.state import DotStore
+from delta_crdt_ex_tpu.models.binned import BinnedStore
+from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap
 from delta_crdt_ex_tpu.ops.apply import OP_ADD, OP_CLEAR, OP_PAD, OP_REMOVE
 from delta_crdt_ex_tpu.runtime import sync as sync_proto, telemetry, tracing
 from delta_crdt_ex_tpu.runtime.clock import Clock
@@ -52,8 +53,8 @@ from delta_crdt_ex_tpu.runtime.transport import Down, LocalTransport, default_tr
 
 logger = logging.getLogger("delta_crdt_ex_tpu")
 
-_COLUMNS = ("key", "valh", "ts", "node", "ctr", "alive", "ctx_gid", "ctx_max")
-_SLICE_COLUMNS = ("key", "valh", "ts", "node", "ctr", "alive", "ctx_gid")
+_COLUMNS = tuple(f.name for f in dataclasses.fields(BinnedStore))
+_SLICE_COLUMNS = ("key", "valh", "ts", "node", "ctr", "alive")
 
 
 def _pow2(n: int, floor: int = 8) -> int:
@@ -66,7 +67,7 @@ def _pow2(n: int, floor: int = 8) -> int:
 class Replica:
     def __init__(
         self,
-        crdt_module=AWLWWMap,
+        crdt_module=BinnedAWLWWMap,
         *,
         name: Any = None,
         node_id: int | None = None,
@@ -78,7 +79,7 @@ class Replica:
         transport: LocalTransport | None = None,
         clock: Clock | None = None,
         capacity: int = 1024,
-        replica_capacity: int = 64,
+        replica_capacity: int = 8,
         tree_depth: int = 12,
         levels_per_round: int = 8,
         sync_timeout: float | None = None,
@@ -122,7 +123,6 @@ class Replica:
         self._tree: list[np.ndarray] | None = None
         self._read_cache: dict | None = None
         self._seq = 0
-        self._slice_size = 1024
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
@@ -137,7 +137,8 @@ class Replica:
             self._rehydrate(snap)
         else:
             self.node_id = node_id if node_id is not None else (secrets.randbits(63) | 1)
-            state = self.model.new(capacity, replica_capacity, self.num_buckets)
+            bin_cap = _pow2(max(capacity // self.num_buckets, 1), floor=4)
+            state = self.model.new(self.num_buckets, bin_cap, replica_capacity)
             # claim slot 0 of the context table for our own gid
             state = dataclasses.replace(
                 state, ctx_gid=state.ctx_gid.at[0].set(jnp.uint64(self.node_id))
@@ -151,15 +152,15 @@ class Replica:
     def _warmup(self) -> None:
         """Pre-trigger the jit compile of the single-op mutate tier so the
         first user mutate doesn't pay it (compile caches are process-wide:
-        only the first replica of a given capacity tier compiles)."""
-        k = _pow2(1)
-        self.model.apply_batch(
+        only the first replica of a given tier compiles)."""
+        self.model.row_apply(
             self.state,
             jnp.int32(self.self_slot),
-            jnp.zeros(k, jnp.int32),
-            jnp.zeros(k, jnp.uint64),
-            jnp.zeros(k, jnp.uint32),
-            jnp.zeros(k, jnp.int64),
+            jnp.full(1, -1, jnp.int32),
+            jnp.zeros((1, 1), jnp.int32),
+            jnp.zeros((1, 1), jnp.uint64),
+            jnp.zeros((1, 1), jnp.uint32),
+            jnp.zeros((1, 1), jnp.int64),
         )
 
     # ------------------------------------------------------------------
@@ -168,7 +169,7 @@ class Replica:
     def _rehydrate(self, snap: Snapshot) -> None:
         self.node_id = snap.node_id
         self._seq = snap.sequence_number
-        self.state = DotStore(**{c: jnp.asarray(snap.arrays[c]) for c in _COLUMNS})
+        self.state = BinnedStore(**{c: jnp.asarray(snap.arrays[c]) for c in _COLUMNS})
         gids = snap.arrays["ctx_gid"]
         slots = np.nonzero(gids == np.uint64(self.node_id))[0]
         assert len(slots) == 1, "rehydrated state must contain our node id"
@@ -271,9 +272,8 @@ class Replica:
     # ------------------------------------------------------------------
     # local mutation batch
 
-    #: largest mutation batch applied in one kernel call — the batch
-    #: shadowing matrix is K², so cap and chunk (diffs bundle per chunk,
-    #: consistent with the reference's per-sync-round bundling)
+    #: largest mutation batch applied in one kernel call (diffs bundle per
+    #: chunk, consistent with the reference's per-sync-round bundling)
     MAX_BATCH = 1024
 
     def _flush(self) -> None:
@@ -285,12 +285,10 @@ class Replica:
 
     def _flush_batch(self, batch: list) -> None:
         n = len(batch)
-        k = _pow2(n)
-
-        op = np.full(k, OP_PAD, np.int32)
-        key = np.zeros(k, np.uint64)
-        valh = np.zeros(k, np.uint32)
-        ts = np.zeros(k, np.int64)
+        key = np.zeros(n, np.uint64)
+        valh = np.zeros(n, np.uint32)
+        op = np.full(n, OP_PAD, np.int32)
+        ts = np.zeros(n, np.int64)
         any_clear = False
         batch_hashes = None
         if n >= 32:
@@ -326,13 +324,28 @@ class Replica:
         # kernel's own changed-key count serves telemetry
         need_winners = self.on_diffs is not None or any_clear
         w_before = self._batch_winner_records(touched, any_clear) if need_winners else {}
-        res = self._apply_with_growth(op, key, valh, ts)
+
+        # apply segments split at clears (clear is a full-state kernel)
+        n_changed = 0
+        ctr_of_op = np.zeros(n, np.uint32)
+        seg_start = 0
+        for i in range(n + 1):
+            if i == n or op[i] == OP_CLEAR:
+                if i > seg_start:
+                    sl = slice(seg_start, i)
+                    n_changed += self._apply_segment(
+                        op[sl], key[sl], valh[sl], ts[sl], ctr_of_op[sl]
+                    )
+                if i < n:  # the clear itself
+                    n_cleared = int(self.state.num_alive())
+                    self.state = self.model.clear_all(self.state)
+                    n_changed += n_cleared
+                seg_start = i + 1
         self._seq += 1
 
         # register payloads for surviving adds (host mirror of the kernel's
-        # batch-shadowing: last op per key wins, a clear shadows everything
+        # shadowing: last op per key wins, a clear shadows everything
         # before it). Keyed by key hash: terms may be unhashable.
-        ctr_assigned = np.asarray(res.ctr_assigned)
         survivor: dict[int, int] = {}
         blocked = False
         for i in range(n - 1, -1, -1):
@@ -344,7 +357,7 @@ class Replica:
         for _kh, i in survivor.items():
             if i >= 0:
                 _f, key_term, value = batch[i]
-                self._payloads[(self.node_id, int(ctr_assigned[i]))] = (key_term, value)
+                self._payloads[(self.node_id, int(ctr_of_op[i]))] = (key_term, value)
 
         if need_winners:
             w_after = self._batch_winner_records(touched, any_clear)
@@ -358,71 +371,87 @@ class Replica:
             if telemetry.has_handlers(telemetry.SYNC_DONE):
                 telemetry.execute(
                     telemetry.SYNC_DONE,
-                    {"keys_updated_count": int(res.n_keys_changed)},
+                    {"keys_updated_count": n_changed},
                     {"name": self.name},
                 )
         self._persist()
 
-    def _batch_winner_records(self, touched: dict[int, Any], full: bool) -> dict[int, tuple]:
-        """Winner records for a mutation batch's diff. Small batches use the
-        O(k·C) vmapped argmax; a batch containing ``clear`` touches every
-        key, so it uses one sort-based winner_slice pass instead."""
-        if full:
-            _w, recs = self._winners_in_buckets(None)
-            return recs
-        if not touched:
-            return {}
-        tkeys = np.zeros(_pow2(max(len(touched), 1)), np.uint64)
-        tkeys[: len(touched)] = list(touched.keys())
-        w = self.model.winners_for_keys(self.state, jnp.asarray(tkeys))
-        return self._winner_records(touched, w)
-
-    def _apply_with_growth(self, op, key, valh, ts):
-        jop, jkey, jvalh, jts = map(jnp.asarray, (op, key, valh, ts))
+    def _apply_segment(self, op, key, valh, ts, ctr_out) -> int:
+        """Apply one clear-free batch segment; fills ``ctr_out`` with the
+        dot counter assigned to each op. Returns the changed-key count."""
+        g = self.model.group_batch(self.num_buckets, op, key, valh, ts)
         while True:
-            res = self.model.apply_batch(
-                self.state, jnp.int32(self.self_slot), jop, jkey, jvalh, jts
+            res = self.model.row_apply(
+                self.state,
+                jnp.int32(self.self_slot),
+                *map(jnp.asarray, (g.rows, g.op, g.key, g.valh, g.ts)),
             )
             if bool(res.ok):
                 self.state = res.state
-                return res
-            self._grow(extra_entries=int(np.sum(op == OP_ADD)), extra_gids=0)
+                break
+            self._grow_bin()
+        urow, cols = g.index
+        ctr_out[:] = np.asarray(res.ctr_assigned)[urow, cols]
+        return int(res.n_keys_changed)
 
-    def _grow(self, extra_entries: int, extra_gids: int) -> None:
-        c = self.state.capacity
-        need_c = int(self.state.num_alive()) + extra_entries
-        new_c = _pow2(need_c, floor=c)  # stays at c when entries fit
-        r = self.state.replica_capacity
-        used_r = int(np.sum(np.asarray(self.state.ctx_gid) != 0))
-        new_r = _pow2(used_r + extra_gids, floor=r)
-        if new_c == c and new_r == r:
-            new_c = c * 2  # safety: the kernel said no — always make progress
-        self.state = self.state.grow(new_c, new_r)
+    def _grow_bin(self) -> None:
+        self.state = self.state.grow(bin_capacity=self.state.bin_capacity * 2)
+        self._grown_telemetry(self.state)
+
+    def _grown_telemetry(self, state) -> None:
         telemetry.execute(
             telemetry.CAPACITY_GROWN,
-            {"capacity": new_c, "replica_capacity": new_r},
+            {"capacity": state.capacity, "replica_capacity": state.replica_capacity},
             {"name": self.name},
         )
 
     # ------------------------------------------------------------------
     # diffs, callback, telemetry (reference causal_crdt.ex:344-404)
 
-    def _winner_records(self, keys: dict[int, Any], w) -> dict[int, tuple]:
+    def _batch_winner_records(self, touched: dict[int, Any], full: bool) -> dict[int, tuple]:
+        """Winner records for a mutation batch's diff. Key-targeted batches
+        use the row-gather winners; a batch containing ``clear`` touches
+        every key, so it uses the full-map pass instead."""
+        if full:
+            return self._winner_records_rows(None)
+        if not touched:
+            return {}
+        tkeys = np.zeros(_pow2(max(len(touched), 1)), np.uint64)
+        tkeys[: len(touched)] = list(touched.keys())
+        w = self.model.winners_for_keys(self.state, jnp.asarray(tkeys))
         found = np.asarray(w.found)
         gid = np.asarray(w.gid)
         ctr = np.asarray(w.ctr)
         valh = np.asarray(w.valh)
         ts = np.asarray(w.ts)
         out = {}
-        for i, kh in enumerate(keys):
+        for i, kh in enumerate(touched):
             if found[i]:
                 out[kh] = (int(gid[i]), int(ctr[i]), int(valh[i]), int(ts[i]))
         return out
 
-    def _after_update(self, touched: dict[int, Any], w_before, w_after) -> None:
-        before = self._winner_records(touched, w_before)
-        after = self._winner_records(touched, w_after)
-        self._emit_diffs(touched, before, after)
+    def _winner_records_rows(self, rows: np.ndarray | None) -> dict[int, tuple]:
+        """LWW winner records, keyed by key hash, within the given bucket
+        rows (``None`` = the whole map, chunked)."""
+        if rows is None:
+            rows = np.arange(self.num_buckets, dtype=np.int32)
+        out: dict[int, tuple] = {}
+        CHUNK = 4096
+        for s in range(0, len(rows), CHUNK):
+            chunk = rows[s : s + CHUNK]
+            padded = np.full(_pow2(len(chunk)), -1, np.int32)
+            padded[: len(chunk)] = chunk
+            w = self.model.winner_rows(self.state, jnp.asarray(padded))
+            win = np.asarray(w.win)
+            u_idx, b_idx = np.nonzero(win)
+            key = np.asarray(w.key)[u_idx, b_idx]
+            gid = np.asarray(w.gid)[u_idx, b_idx]
+            ctr = np.asarray(w.ctr)[u_idx, b_idx]
+            valh = np.asarray(w.valh)[u_idx, b_idx]
+            ts = np.asarray(w.ts)[u_idx, b_idx]
+            for i in range(len(key)):
+                out[int(key[i])] = (int(gid[i]), int(ctr[i]), int(valh[i]), int(ts[i]))
+        return out
 
     def _emit_diffs(self, touched: dict[int, Any], before: dict, after: dict) -> None:
         """Reference emission rules (``causal_crdt.ex:344-381``): telemetry
@@ -473,17 +502,10 @@ class Replica:
         return out
 
     def _read_all_items(self) -> list[tuple[Any, Any]]:
-        w = self.model.winner_slice(self.state, None, out_size=self.state.capacity)
-        count = int(w.count)
-        key = np.asarray(w.key)[:count]
-        gid = np.asarray(w.gid)[:count]
-        ctr = np.asarray(w.ctr)[:count]
+        recs = self._winner_records_rows(None)
         return [
-            (
-                self._key_terms[int(key[i])],
-                self._payloads[(int(gid[i]), int(ctr[i]))][1],
-            )
-            for i in range(count)
+            (self._key_terms[kh], self._payloads[(gid, ctr)][1])
+            for kh, (gid, ctr, _valh, _ts) in recs.items()
         ]
 
     def read_items(self) -> list[tuple[Any, Any]]:
@@ -498,7 +520,7 @@ class Replica:
 
     def _ensure_tree(self) -> list[np.ndarray]:
         if self._tree is None:
-            levels = self.model.digest_tree(self.state, self.tree_depth)
+            levels = self.model.tree_from_leaves(self.state.leaf)
             self._tree = [np.asarray(l) for l in levels]
         return self._tree
 
@@ -598,25 +620,20 @@ class Replica:
         self._outstanding.pop(msg.frm, None)
 
     def _send_entries(self, to, buckets: np.ndarray, originator) -> None:
-        buckets = np.asarray(buckets, np.int64)
-        mask = np.zeros(self.num_buckets, bool)
-        mask[buckets] = True
-        jmask = jnp.asarray(mask)
-        while True:
-            res = self.model.extract_buckets(self.state, jmask, out_size=self._slice_size)
-            if bool(res.ok):
-                break
-            self._slice_size *= 2
-        sl = res.slice
+        rows = np.full(_pow2(max(len(buckets), 1)), -1, np.int32)
+        rows[: len(buckets)] = np.asarray(buckets, np.int32)
+        sl = self.model.extract_rows(self.state, jnp.asarray(rows))
         arrays = {c: np.asarray(getattr(sl, c)) for c in _SLICE_COLUMNS}
+        arrays["rows"] = rows
         # context rows for exactly the synced buckets (bucket-atomic sync:
         # coverage never outruns the shipped entries)
-        arrays["ctx_rows"] = np.asarray(self.state.ctx_max[jnp.asarray(buckets)])
+        arrays["ctx_rows"] = np.asarray(sl.ctx_rows)
+        arrays["ctx_gid"] = np.asarray(sl.ctx_gid)
         gids = arrays["ctx_gid"][arrays["node"]]
         payloads = {}
-        alive = arrays["alive"]
-        for i in np.nonzero(alive)[0]:
-            dot = (int(gids[i]), int(arrays["ctr"][i]))
+        u_idx, b_idx = np.nonzero(arrays["alive"])
+        for u, b in zip(u_idx, b_idx):
+            dot = (int(gids[u, b]), int(arrays["ctr"][u, b]))
             payloads[dot] = self._payloads[dot]
         self.transport.send(
             to,
@@ -637,37 +654,29 @@ class Replica:
     def _handle_entries_inner(self, msg: sync_proto.EntriesMsg) -> None:
         self._flush()
         t0 = time.perf_counter()
-        entry_cols = {c: jnp.asarray(msg.arrays[c]) for c in _SLICE_COLUMNS}
-        remote = self.model.slice_to_store(
-            entry_cols,
-            jnp.asarray(msg.arrays["ctx_rows"]),
-            jnp.asarray(msg.buckets),
-            self.num_buckets,
+        a = msg.arrays
+        sl = self.model.RowSlice(
+            rows=jnp.asarray(a["rows"]),
+            key=jnp.asarray(a["key"]),
+            valh=jnp.asarray(a["valh"]),
+            ts=jnp.asarray(a["ts"]),
+            node=jnp.asarray(a["node"]),
+            ctr=jnp.asarray(a["ctr"]),
+            alive=jnp.asarray(a["alive"]),
+            ctx_rows=jnp.asarray(a["ctx_rows"]),
+            ctx_gid=jnp.asarray(a["ctx_gid"]),
         )
-        mask = np.zeros(self.num_buckets, bool)
-        mask[msg.buckets] = True
-        jmask = jnp.asarray(mask)
+        rows_np = a["rows"]
 
-        _w, keys_b = self._winners_in_buckets(jmask)
+        keys_b = self._winner_records_rows(rows_np[rows_np >= 0])
         # payloads first: diff values for incoming winners must resolve
         self._payloads.update(msg.payloads)
         for _dot, (key_term, _val) in msg.payloads.items():
             self._key_terms[key_hash64(key_term)] = key_term
 
-        slice_alive = int(np.sum(msg.arrays["alive"]))
-        remote_gids = set(np.asarray(remote.ctx_gid)[np.asarray(remote.ctx_gid) != 0].tolist())
-        while True:
-            res = self.model.join(self.state, remote, jmask)
-            if bool(res.ok):
-                self.state = res.state
-                break
-            local_gids = set(np.asarray(self.state.ctx_gid)[np.asarray(self.state.ctx_gid) != 0].tolist())
-            self._grow(
-                extra_entries=slice_alive,
-                extra_gids=len(remote_gids - local_gids),
-            )
+        self._merge_with_growth(sl)
 
-        _w, keys_a = self._winners_in_buckets(jmask)
+        keys_a = self._winner_records_rows(rows_np[rows_np >= 0])
         touched: dict[int, Any] = {}
         for kh in set(keys_b) | set(keys_a):
             term = self._key_terms.get(kh)
@@ -680,33 +689,24 @@ class Replica:
             {
                 "duration_s": time.perf_counter() - t0,
                 "buckets": int(len(msg.buckets)),
-                "entries": slice_alive,
+                "entries": int(np.sum(a["alive"])),
             },
             {"name": self.name},
         )
         self._persist()
 
-    def _winners_in_buckets(self, jmask):
-        while True:
-            w = self.model.winner_slice(self.state, jmask, out_size=self._slice_size)
-            if bool(w.ok):
-                break
-            self._slice_size *= 2
-        count = int(w.count)
-        key = np.asarray(w.key)[:count]
-        gid = np.asarray(w.gid)[:count]
-        ctr = np.asarray(w.ctr)[:count]
-        valh = np.asarray(w.valh)[:count]
-        ts = np.asarray(w.ts)[:count]
-        records = {
-            int(key[i]): (int(gid[i]), int(ctr[i]), int(valh[i]), int(ts[i]))
-            for i in range(count)
-        }
-        return w, records
+    #: initial kill-budget tier for merges (rows the amin test flags as
+    #: possibly containing kills; most sync rounds flag none or few)
+    KILL_BUDGET = 16
+
+    def _merge_with_growth(self, sl) -> None:
+        self.state, _res = self.model.merge_into(
+            self.state, sl, kill_budget=self.KILL_BUDGET, on_grow=self._grown_telemetry
+        )
 
     # ------------------------------------------------------------------
     # bench parity helpers (reference BenchmarkHelper, benchmark_helper.ex:
-    # 2-14 — :hibernate forces GC-like compaction before timing, :ping
+    # 2-14 — :hibernate forces GC-like state compaction before timing, :ping
     # round-trips the mailbox)
 
     def hibernate(self) -> str:
@@ -732,14 +732,14 @@ class Replica:
             node = np.asarray(self.state.node)
             ctr = np.asarray(self.state.ctr)
             alive = np.asarray(self.state.alive)
+            keyarr = np.asarray(self.state.key)
             gids = np.asarray(self.state.ctx_gid)[node]
+            u_idx, b_idx = np.nonzero(alive)
             live = {
-                (int(gids[i]), int(ctr[i])) for i in np.nonzero(alive)[0]
+                (int(gids[u, b]), int(ctr[u, b])) for u, b in zip(u_idx, b_idx)
             }
             self._payloads = {d: p for d, p in self._payloads.items() if d in live}
-            keep_keys = {
-                np.asarray(self.state.key)[i].item() for i in np.nonzero(alive)[0]
-            }
+            keep_keys = {int(keyarr[u, b]) for u, b in zip(u_idx, b_idx)}
             self._key_terms = {h: t for h, t in self._key_terms.items() if h in keep_keys}
 
     # ------------------------------------------------------------------

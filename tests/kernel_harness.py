@@ -82,3 +82,92 @@ class KernelMap:
 
     def alive_count(self) -> int:
         return int(self.state.num_alive())
+
+
+class BinnedKernelMap:
+    """Same harness over the bucket-binned engine (models/binned.py)."""
+
+    def __init__(self, gid: int, capacity: int = 64, rcap: int = 8, num_buckets: int = 64):
+        from delta_crdt_ex_tpu.models.binned import BinnedStore
+        from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap
+
+        self.M = BinnedAWLWWMap
+        self.gid = gid
+        bin_cap = 4
+        while bin_cap * num_buckets < capacity:  # power-of-two tier
+            bin_cap *= 2
+        state = BinnedStore.new(num_buckets, bin_cap, rcap)
+        self.state = dataclasses.replace(
+            state, ctx_gid=state.ctx_gid.at[0].set(jnp.uint64(gid))
+        )
+        self.slot = 0
+
+    def _apply(self, op_rows):
+        # split at clears (clear is a full-state kernel, not a row op)
+        seg: list = []
+        results = []
+        for row in op_rows:
+            if row[0] == OP_CLEAR:
+                results.append(self._apply_segment(seg))
+                seg = []
+                self.state = self.M.clear_all(self.state)
+            else:
+                seg.append(row)
+        results.append(self._apply_segment(seg))
+        return results[-1]
+
+    def _apply_segment(self, op_rows):
+        if not op_rows:
+            return None
+        op = np.array([r[0] for r in op_rows], np.int32)
+        key = np.array([r[1] for r in op_rows], np.uint64)
+        valh = np.array([r[2] for r in op_rows], np.uint32)
+        ts = np.array([r[3] for r in op_rows], np.int64)
+        g = self.M.group_batch(self.state.num_buckets, op, key, valh, ts)
+        while True:
+            res = self.M.row_apply(
+                self.state,
+                jnp.int32(self.slot),
+                *map(jnp.asarray, (g.rows, g.op, g.key, g.valh, g.ts)),
+            )
+            if bool(res.ok):
+                self.state = res.state
+                return res
+            self.state = self.state.grow(bin_capacity=self.state.bin_capacity * 2)
+
+    def add(self, key: int, val: int, ts: int):
+        return self._apply([(OP_ADD, key, val, ts)])
+
+    def remove(self, key: int, ts: int = 0):
+        return self._apply([(OP_REMOVE, key, 0, ts)])
+
+    def clear(self, ts: int = 0):
+        return self._apply([(OP_CLEAR, 0, 0, ts)])
+
+    def batch(self, rows):
+        return self._apply(rows)
+
+    def join_from(self, other: "BinnedKernelMap"):
+        rows = np.arange(other.state.num_buckets, dtype=np.int32)
+        sl = self.M.extract_rows(other.state, jnp.asarray(rows))
+        return self.merge_slice(sl)
+
+    def merge_slice(self, sl):
+        self.state, res = self.M.merge_into(self.state, sl)
+        return res
+
+    def read(self) -> dict[int, int]:
+        rows = jnp.arange(self.state.num_buckets, dtype=jnp.int32)
+        w = self.M.winner_rows(self.state, rows)
+        win = np.asarray(w.win)
+        keys = np.asarray(w.key)[win]
+        vals = np.asarray(w.valh)[win]
+        return {int(k): int(v) for k, v in zip(keys, vals)}
+
+    def ctx(self) -> dict[int, int]:
+        gids = np.asarray(self.state.ctx_gid)
+        maxs = np.asarray(self.state.global_ctx())
+        return {int(g): int(m) for g, m in zip(gids, maxs) if g != 0 and m != 0}
+
+    def alive_count(self) -> int:
+        return int(self.state.num_alive())

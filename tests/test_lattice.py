@@ -12,38 +12,45 @@ import pytest
 from hypothesis import given, settings, strategies as st
 
 from delta_crdt_ex_tpu.utils.pyref import PyAWLWWMap
-from tests.kernel_harness import KernelMap
+from tests.kernel_harness import BinnedKernelMap, KernelMap
+
+
+@pytest.fixture(params=["flat", "binned"], scope="module")
+def M(request):
+    """Both lattice engines must pass the whole suite: the flat heap
+    (models/state.py) and the bucket-binned layout (models/binned.py)."""
+    return KernelMap if request.param == "flat" else BinnedKernelMap
 
 A_GID, B_GID = 11, 22
 
 
-def test_can_add_and_read_a_value():
-    m = KernelMap(A_GID)
+def test_can_add_and_read_a_value(M):
+    m = M(A_GID)
     m.add(1, 2, ts=1)
     assert m.read() == {1: 2}
 
 
-def test_can_join_two_adds():
-    a = KernelMap(A_GID)
+def test_can_join_two_adds(M):
+    a = M(A_GID)
     a.add(1, 2, ts=1)
-    b = KernelMap(B_GID)
+    b = M(B_GID)
     b.add(2, 2, ts=2)
     a.join_from(b)
     assert a.read() == {1: 2, 2: 2}
 
 
-def test_can_remove_elements():
-    m = KernelMap(A_GID)
+def test_can_remove_elements(M):
+    m = M(A_GID)
     m.add(1, 2, ts=1)
     m.remove(1)
     assert m.read() == {}
 
 
-def test_remove_only_kills_observed_dots_add_wins():
+def test_remove_only_kills_observed_dots_add_wins(M):
     # concurrent add at B vs remove at A: the unobserved add survives
-    a = KernelMap(A_GID)
+    a = M(A_GID)
     a.add(1, 2, ts=1)
-    b = KernelMap(B_GID)
+    b = M(B_GID)
     b.join_from(a)
     b.add(1, 99, ts=2)  # B's new dot, unseen by A
     a.remove(1)  # kills only A-observed dots
@@ -51,8 +58,8 @@ def test_remove_only_kills_observed_dots_add_wins():
     assert b.read() == {1: 99}
 
 
-def test_can_resolve_conflicts_lww():
-    m = KernelMap(A_GID)
+def test_can_resolve_conflicts_lww(M):
+    m = M(A_GID)
     m.add(1, 2, ts=1)
     m.add(1, 3, ts=2)
     assert m.read() == {1: 3}
@@ -60,33 +67,33 @@ def test_can_resolve_conflicts_lww():
     assert m.alive_count() == 1
 
 
-def test_context_stays_compressed():
+def test_context_stays_compressed(M):
     # reference "can compute actual dots present": state context is the
     # compressed per-node max, not a growing dot list
-    m = KernelMap(A_GID)
+    m = M(A_GID)
     m.add(1, 2, ts=1)
     m.add(1, 3, ts=2)
     assert m.ctx() == {A_GID: 2}
     assert m.alive_count() == 1
 
 
-def test_clear_removes_everything():
-    m = KernelMap(A_GID)
+def test_clear_removes_everything(M):
+    m = M(A_GID)
     m.add(1, 2, ts=1)
     m.add(2, 3, ts=2)
     m.clear()
     assert m.read() == {}
     # cleared dots stay observed: rejoining an old copy must not resurrect
-    old = KernelMap(B_GID)
+    old = M(B_GID)
     old.add(3, 4, ts=3)
     m.join_from(old)
     assert m.read() == {3: 4}
 
 
-def test_batch_sequential_semantics():
+def test_batch_sequential_semantics(M):
     from delta_crdt_ex_tpu.ops.apply import OP_ADD, OP_CLEAR, OP_REMOVE
 
-    m = KernelMap(A_GID)
+    m = M(A_GID)
     m.batch(
         [
             (OP_ADD, 1, 10, 1),
@@ -101,20 +108,20 @@ def test_batch_sequential_semantics():
     assert m.read() == {5: 50}
 
 
-def test_join_is_idempotent_and_commutative():
-    a = KernelMap(A_GID)
+def test_join_is_idempotent_and_commutative(M):
+    a = M(A_GID)
     a.add(1, 1, ts=1)
     a.add(2, 2, ts=2)
-    b = KernelMap(B_GID)
+    b = M(B_GID)
     b.add(2, 22, ts=3)
     b.add(3, 3, ts=4)
 
-    ab = KernelMap(A_GID)
+    ab = M(A_GID)
     ab.add(1, 1, ts=1)
     ab.add(2, 2, ts=2)
     ab.join_from(b)
     ab.join_from(b)  # idempotent
-    ba = KernelMap(B_GID)
+    ba = M(B_GID)
     ba.add(2, 22, ts=3)
     ba.add(3, 3, ts=4)
     ba.join_from(a)
@@ -133,10 +140,10 @@ ops_strategy = st.lists(
 
 @settings(max_examples=60, deadline=None)
 @given(ops_strategy)
-def test_property_single_replica_matches_dict_model(ops):
+def test_property_single_replica_matches_dict_model(M, ops):
     """Reference property: arbitrary add/remove sequence == plain Map
     (``aw_lww_map_test.exs:51-86``)."""
-    m = KernelMap(A_GID, capacity=128)
+    m = M(A_GID, capacity=128)
     model = {}
     spec = PyAWLWWMap()
     for i, (op, key, val) in enumerate(ops):
@@ -167,12 +174,12 @@ def test_property_single_replica_matches_dict_model(ops):
     ),
     st.randoms(use_true_random=False),
 )
-def test_property_multi_replica_convergence_vs_spec(script, rnd):
+def test_property_multi_replica_convergence_vs_spec(M, script, rnd):
     """Random concurrent ops + random pairwise joins on 3 replicas: the
     kernel lattice and the Python spec stay in lockstep, and full pairwise
     sync converges everyone to the same read."""
     gids = [101, 202, 303]
-    ks = [KernelMap(g, capacity=128) for g in gids]
+    ks = [M(g, capacity=128) for g in gids]
     specs = [PyAWLWWMap() for _ in gids]
     ts = 0
     for who, op, key, val in script:
