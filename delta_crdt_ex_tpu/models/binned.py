@@ -33,9 +33,14 @@ Maintained summaries (the O(delta) machinery):
     fill : int32[L]        per-row append pointer (alive ⊆ [0, fill))
     amin : uint32[L, R]    min ctr among ALIVE entries per (bucket,
                            writer-slot); U32_MAX when none. A remote
-                           context row can only kill here if it reaches
-                           this minimum — the O(R) kill-pruning test
-                           that lets merges skip un-killable rows.
+                           context interval can only kill here if it
+                           reaches this minimum — the O(R) kill-pruning
+                           test that lets merges skip un-killable rows.
+    amax : uint32[L, R]    max ctr among ALIVE entries per (bucket,
+                           writer-slot); 0 when none. The other half of
+                           the pruning test: an interval ``(lo, hi]``
+                           with ``lo >= amax`` cannot kill either (all
+                           alive dots predate the claim).
     leaf : uint32[L]       leaf digests, updated incrementally (the
                            ``MerkleMap.put`` analog, ``causal_crdt.ex:
                            390-394``): wrapping sum of alive ehash.
@@ -68,7 +73,7 @@ U32_MAX = jnp.uint32(0xFFFFFFFF)
     jax.tree_util.register_dataclass,
     data_fields=[
         "key", "valh", "ts", "node", "ctr", "alive", "ehash",
-        "fill", "amin", "leaf", "ctx_gid", "ctx_max",
+        "fill", "amin", "amax", "leaf", "ctx_gid", "ctx_max",
     ],
     meta_fields=[],
 )
@@ -83,6 +88,7 @@ class BinnedStore:
     ehash: jax.Array  # uint32[L, B]
     fill: jax.Array  # int32[L]
     amin: jax.Array  # uint32[L, R]
+    amax: jax.Array  # uint32[L, R]
     leaf: jax.Array  # uint32[L]
     ctx_gid: jax.Array  # uint64[R]
     ctx_max: jax.Array  # uint32[L, R]
@@ -120,6 +126,7 @@ class BinnedStore:
             ehash=jnp.zeros((L, B), jnp.uint32),
             fill=jnp.zeros(L, jnp.int32),
             amin=jnp.full((L, R), U32_MAX, jnp.uint32),
+            amax=jnp.zeros((L, R), jnp.uint32),
             leaf=jnp.zeros(L, jnp.uint32),
             ctx_gid=jnp.zeros(R, jnp.uint64),
             ctx_max=jnp.zeros((L, R), jnp.uint32),
@@ -148,6 +155,7 @@ class BinnedStore:
             amin=jnp.pad(self.amin, ((0, 0), (0, dr)), constant_values=U32_MAX)
             if dr
             else self.amin,
+            amax=jnp.pad(self.amax, ((0, 0), (0, dr))) if dr else self.amax,
             leaf=self.leaf,
             ctx_gid=jnp.pad(self.ctx_gid, (0, dr)) if dr else self.ctx_gid,
             ctx_max=jnp.pad(self.ctx_max, ((0, 0), (0, dr))) if dr else self.ctx_max,

@@ -112,6 +112,14 @@ def merge_into(state: BinnedStore, sl, kill_budget: int = 16, on_grow=None):
         res = jit_merge_slice(state, sl, kill_budget=kill_budget)
         if bool(res.ok):
             return res.state, res
+        if bool(res.need_ctx_gap):
+            # a delta-interval slice below our observed horizon — growth
+            # cannot heal this; the sender must fall back to a full-row
+            # (state-form) slice
+            raise ValueError(
+                "delta-interval slice is not contiguous with the local "
+                "context; re-sync with a full-row slice (ctx_lo=0)"
+            )
         if bool(res.need_gid_grow):
             state = state.grow(replica_capacity=state.replica_capacity * 2)
             if on_grow:

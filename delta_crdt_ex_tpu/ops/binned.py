@@ -35,6 +35,7 @@ remove, per-key LWW by (ts, writer gid, ctr), causal join
 
 from __future__ import annotations
 
+import dataclasses
 from typing import NamedTuple
 
 import jax.numpy as jnp
@@ -94,6 +95,16 @@ def _row_amin(node, ctr, alive, u, r):
         jnp.full((u, r), U32_MAX, jnp.uint32)
         .at[uu, node]
         .min(jnp.where(alive, ctr, U32_MAX))
+    )
+
+
+def _row_amax(node, ctr, alive, u, r):
+    """uint32[U, R] max alive counter per (row, writer slot); 0 if none."""
+    uu = jnp.broadcast_to(jnp.arange(u)[:, None], node.shape)
+    return (
+        jnp.zeros((u, r), jnp.uint32)
+        .at[uu, node]
+        .max(jnp.where(alive, ctr, jnp.uint32(0)))
     )
 
 
@@ -211,6 +222,7 @@ def row_apply(
     packed, alive_p, fill_rows = _row_compact(cols, alive2)
 
     amin_rows = _row_amin(packed["node"], packed["ctr"], alive_p, u, R)
+    amax_rows = _row_amax(packed["node"], packed["ctr"], alive_p, u, R)
     leaf_rows = jnp.sum(
         jnp.where(alive_p, packed["ehash"], jnp.uint32(0)), axis=1, dtype=jnp.uint32
     )
@@ -221,6 +233,7 @@ def row_apply(
         alive=state.alive.at[rows_safe].set(alive_p, mode="drop"),
         fill=state.fill.at[rows_safe].set(fill_rows, mode="drop"),
         amin=state.amin.at[rows_safe].set(amin_rows, mode="drop"),
+        amax=state.amax.at[rows_safe].set(amax_rows, mode="drop"),
         leaf=state.leaf.at[rows_safe].set(leaf_rows, mode="drop"),
         ctx_gid=state.ctx_gid,
         ctx_max=state.ctx_max.at[rows_safe, self_slot].max(own_max, mode="drop"),
@@ -253,6 +266,7 @@ def clear_all(state: BinnedStore) -> BinnedStore:
         ehash=state.ehash,
         fill=jnp.zeros_like(state.fill),
         amin=jnp.full_like(state.amin, U32_MAX),
+        amax=jnp.zeros_like(state.amax),
         leaf=jnp.zeros_like(state.leaf),
         ctx_gid=state.ctx_gid,
         ctx_max=state.ctx_max,
@@ -267,7 +281,14 @@ class RowSlice(NamedTuple):
     """Wire format of the sync data plane: gathered rows of the sender's
     store plus the matching context rows — the bucket-atomic analog of the
     reference's ``%{crdt | dots: …, value: Map.take(…)}`` diff payload
-    (``causal_crdt.ex:115-119``)."""
+    (``causal_crdt.ex:115-119``).
+
+    The context travels as a per-(bucket, writer) counter **interval**
+    ``(ctx_lo, ctx_rows]`` — Almeida et al.'s delta-interval: the slice
+    claims knowledge of exactly the dots in the interval, so a partial
+    (delta) slice cannot over-claim and kill older dots it did not ship.
+    Full-row state slices use ``ctx_lo = 0`` (the compressed state form,
+    ``Dots.compress``, ``aw_lww_map.ex:13-20``)."""
 
     rows: jnp.ndarray  # int32[U] bucket indices (-1 = padding)
     key: jnp.ndarray  # uint64[U, S]
@@ -276,7 +297,8 @@ class RowSlice(NamedTuple):
     node: jnp.ndarray  # int32[U, S] (sender-local slots)
     ctr: jnp.ndarray  # uint32[U, S]
     alive: jnp.ndarray  # bool[U, S]
-    ctx_rows: jnp.ndarray  # uint32[U, Rr]
+    ctx_rows: jnp.ndarray  # uint32[U, Rr] interval upper bounds (inclusive)
+    ctx_lo: jnp.ndarray  # uint32[U, Rr] interval lower bounds (exclusive)
     ctx_gid: jnp.ndarray  # uint64[Rr]
 
 
@@ -296,6 +318,7 @@ def extract_rows(state: BinnedStore, rows: jnp.ndarray) -> RowSlice:
         ctr=g["ctr"],
         alive=state.alive[rows_clip] & v,
         ctx_rows=state.ctx_max[rows_clip] * valid[:, None].astype(jnp.uint32),
+        ctx_lo=jnp.zeros_like(state.ctx_max[rows_clip]),
         ctx_gid=state.ctx_gid,
     )
 
@@ -306,6 +329,9 @@ class MergeResult(NamedTuple):
     need_gid_grow: jnp.ndarray  # bool: unknown writer gids overflowed R
     need_kill_tier: jnp.ndarray  # bool: flagged rows exceeded the kill budget
     need_fill_compact: jnp.ndarray  # bool: some row ran out of bin space
+    need_ctx_gap: jnp.ndarray  # bool: delta-interval not contiguous with our
+    # context (caller must fall back to a full-row sync; never raised by
+    # ctx_lo = 0 state-form slices)
     n_inserted: jnp.ndarray  # int32
     n_killed: jnp.ndarray  # int32
 
@@ -317,12 +343,13 @@ def merge_slice(state: BinnedStore, sl: RowSlice, kill_budget: int) -> MergeResu
     Per synced bucket the reference join applies (``aw_lww_map.ex:
     153-209``):
       - insert remote entries not covered by the local context (s2 ∖ c1);
-      - kill local entries covered by the remote context and absent from
-        the remote entries (survivors = (s1∩s2) ∪ (s1∖c2));
-      - context union (per-replica max).
-    The kill pass gathers only rows where ``amin`` proves a kill is
-    possible; ``kill_budget`` rows at most (static tier), else
-    ``ok=False`` and the host retries with a bigger tier.
+      - kill local entries covered by the remote context interval and
+        absent from the remote entries (survivors = (s1∩s2) ∪ (s1∖c2));
+      - context union (per-replica max), valid because the interval is
+        verified contiguous with the local context (``need_ctx_gap``).
+    The kill pass gathers only rows where the ``amin``/``amax`` test
+    proves a kill is possible; ``kill_budget`` rows at most (static
+    tier), else ``ok=False`` and the host retries with a bigger tier.
     """
     L = state.num_buckets
     B = state.bin_capacity
@@ -338,11 +365,23 @@ def merge_slice(state: BinnedStore, sl: RowSlice, kill_budget: int) -> MergeResu
     # remote context rows in local slot indexing: [U, R]
     uu_r = jnp.broadcast_to(jnp.arange(u)[:, None], sl.ctx_rows.shape)
     remap_cols = jnp.broadcast_to(gids.remap[None, :], sl.ctx_rows.shape)
+    rcols = jnp.where(remap_cols >= 0, remap_cols, R)
+    # empty intervals (lo == hi) claim nothing: mask them out of BOTH
+    # bounds, or an idle writer's row would read as a (0, hi] state-form
+    # claim and kill dots the slice never shipped
+    nonempty = sl.ctx_rows > sl.ctx_lo
     rdense = (
         jnp.zeros((u, R), jnp.uint32)
-        .at[uu_r, jnp.where(remap_cols >= 0, remap_cols, R)]
-        .max(sl.ctx_rows, mode="drop")
+        .at[uu_r, rcols]
+        .max(jnp.where(nonempty, sl.ctx_rows, jnp.uint32(0)), mode="drop")
     )
+    # interval lower bounds in local slots (0 where nothing shipped)
+    ldense = (
+        jnp.full((u, R), U32_MAX, jnp.uint32)
+        .at[uu_r, rcols]
+        .min(jnp.where(nonempty, sl.ctx_lo, U32_MAX), mode="drop")
+    )
+    ldense = jnp.where(ldense == U32_MAX, jnp.uint32(0), ldense)
 
     # --- insert pass (s2 ∖ c1) -------------------------------------------
     ln = gids.remap[jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1)]  # [U, S]
@@ -358,6 +397,11 @@ def merge_slice(state: BinnedStore, sl: RowSlice, kill_budget: int) -> MergeResu
     n_ins_row = jnp.sum(ins, axis=1, dtype=jnp.int32)
     fill_rows = state.fill[rows_clip]
     need_fill_compact = jnp.any(valid & (fill_rows + n_ins_row > B))
+    # delta-interval contiguity: advancing ctx to hi is only sound if our
+    # context already reaches lo (no unobserved gap beneath the interval)
+    need_ctx_gap = jnp.any(
+        valid[:, None] & (rdense > ldense) & (local_ctx_rows < ldense)
+    )
     pos = fill_rows[:, None] + ins_rank  # [U, S] target bin slot
 
     # overflowing rows (pos >= B) must not clip into valid slots — drop;
@@ -385,17 +429,21 @@ def merge_slice(state: BinnedStore, sl: RowSlice, kill_budget: int) -> MergeResu
     amin2 = state.amin.at[rows_clip[:, None], ln_clip].min(
         jnp.where(ins, sl.ctr, U32_MAX), mode="drop"
     )
+    amax2 = state.amax.at[rows_clip[:, None], ln_clip].max(
+        jnp.where(ins, sl.ctr, jnp.uint32(0)), mode="drop"
+    )
     leaf_add = jnp.sum(jnp.where(ins, eh_ins, jnp.uint32(0)), axis=1, dtype=jnp.uint32)
     leaf2 = state.leaf.at[rows_safe].add(leaf_add, mode="drop")
     ctx2 = state.ctx_max.at[rows_safe].max(rdense, mode="drop")
     n_inserted = jnp.sum(ins.astype(jnp.int32))
 
-    # --- kill pass ((s1∩s2) ∪ (s1∖c2)), pruned by amin --------------------
-    # a remote context row can only kill a local dot if it reaches that
-    # (bucket, writer)'s minimum alive counter — all computed on the
-    # PRE-merge state, as the join semantics demand
+    # --- kill pass ((s1∩s2) ∪ (s1∖c2)), pruned by amin/amax ---------------
+    # the interval (lo, hi] can only kill a local dot if it overlaps the
+    # [amin, amax] alive-counter span of some (bucket, writer) — all
+    # computed on the PRE-merge state, as the join semantics demand
     amin_rows = state.amin[rows_clip]
-    flagged = valid & jnp.any(rdense >= amin_rows, axis=1)
+    amax_rows = state.amax[rows_clip]
+    flagged = valid & jnp.any((rdense >= amin_rows) & (ldense < amax_rows), axis=1)
     n_flagged = jnp.sum(flagged.astype(jnp.int32))
     need_kill_tier = n_flagged > kill_budget
 
@@ -415,9 +463,10 @@ def merge_slice(state: BinnedStore, sl: RowSlice, kill_budget: int) -> MergeResu
     l_ehash = ehash2[k_rows_clip]
 
     k_rdense = rdense[order]  # [KB, R]
+    k_ldense = ldense[order]
     covered = (
         jnp.take_along_axis(k_rdense, l_node.astype(jnp.int32), axis=1) >= l_ctr
-    )
+    ) & (jnp.take_along_axis(k_ldense, l_node.astype(jnp.int32), axis=1) < l_ctr)
     # presence among remote slice dots of the same rows: [KB, B] vs [KB, S]
     r_node = ln_clip[order]
     r_ctr = sl.ctr[order]
@@ -433,9 +482,11 @@ def merge_slice(state: BinnedStore, sl: RowSlice, kill_budget: int) -> MergeResu
     leaf3 = leaf2.at[k_rows].add(~leaf_sub + jnp.uint32(1), mode="drop")
     amin_k = _row_amin(l_node, l_ctr, l_alive & ~die, kb, R)
     amin3 = amin2.at[k_rows].set(amin_k, mode="drop")
+    amax_k = _row_amax(l_node, l_ctr, l_alive & ~die, kb, R)
+    amax3 = amax2.at[k_rows].set(amax_k, mode="drop")
     n_killed = jnp.sum(die.astype(jnp.int32))
 
-    ok = ~(gids.overflow | need_kill_tier | need_fill_compact)
+    ok = ~(gids.overflow | need_kill_tier | need_fill_compact | need_ctx_gap)
     new_state = BinnedStore(
         key=key2,
         valh=valh2,
@@ -446,6 +497,7 @@ def merge_slice(state: BinnedStore, sl: RowSlice, kill_budget: int) -> MergeResu
         ehash=ehash2,
         fill=fill2,
         amin=amin3,
+        amax=amax3,
         leaf=leaf3,
         ctx_gid=gids.ctx_gid,
         ctx_max=ctx2,
@@ -456,6 +508,7 @@ def merge_slice(state: BinnedStore, sl: RowSlice, kill_budget: int) -> MergeResu
         gids.overflow,
         need_kill_tier,
         need_fill_compact,
+        need_ctx_gap,
         n_inserted,
         n_killed,
     )
@@ -556,6 +609,17 @@ def winner_rows(state: BinnedStore, rows: jnp.ndarray) -> RowWinners:
 # maintenance
 
 
+def init_from_columns(state: BinnedStore) -> BinnedStore:
+    """Rebuild ``ehash`` from the entry columns, then every maintained
+    invariant (:func:`compact_rows`). For host-constructed states
+    (benchmarks, bulk loads): the host fills key/valh/ts/node/ctr/alive
+    and the context tables; the device derives the rest in one pass."""
+    ehash = entry_hash(
+        state.key, state.ctx_gid[state.node], state.ctr, state.ts, state.valh
+    )
+    return compact_rows(dataclasses.replace(state, ehash=ehash))
+
+
 def compact_rows(state: BinnedStore) -> BinnedStore:
     """Full repack: reclaim holes left by merge kills, rebuild every
     maintained invariant. One dense pass; host calls it when a merge
@@ -565,6 +629,7 @@ def compact_rows(state: BinnedStore) -> BinnedStore:
     cols = {c: getattr(state, c) for c in _ROW_COLS}
     packed, alive_p, fill = _row_compact(cols, state.alive)
     amin = _row_amin(packed["node"], packed["ctr"], alive_p, L, R)
+    amax = _row_amax(packed["node"], packed["ctr"], alive_p, L, R)
     leaf = jnp.sum(
         jnp.where(alive_p, packed["ehash"], jnp.uint32(0)), axis=1, dtype=jnp.uint32
     )
@@ -573,6 +638,7 @@ def compact_rows(state: BinnedStore) -> BinnedStore:
         alive=alive_p,
         fill=fill,
         amin=amin,
+        amax=amax,
         leaf=leaf,
         ctx_gid=state.ctx_gid,
         ctx_max=state.ctx_max,

@@ -1,7 +1,5 @@
 from delta_crdt_ex_tpu.parallel.batched_sync import (
-    fanout_join,
-    jit_fanout_join,
-    jit_ring_gossip_round,
+    fanout_merge,
     ring_gossip_round,
     stack_states,
     unstack_states,
@@ -16,10 +14,8 @@ from delta_crdt_ex_tpu.parallel.mesh_gossip import (
 
 __all__ = [
     "AXIS",
-    "fanout_join",
+    "fanout_merge",
     "gossip_train_step",
-    "jit_fanout_join",
-    "jit_ring_gossip_round",
     "make_mesh",
     "place_states",
     "replica_sharding",

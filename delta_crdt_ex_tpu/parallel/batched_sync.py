@@ -3,50 +3,61 @@
 The reference loops over neighbours one message at a time
 (``causal_crdt.ex:264-283``); on TPU the neighbour axis becomes a batch
 dimension (SURVEY §2.2): replica states are stacked on a leading axis and
-one device call joins a delta into **all** neighbour states at once — the
-BASELINE north-star's 64-neighbour fan-in. The same shape also batches a
-whole gossip round among N chip-resident replicas (each joins its ring
-predecessor) in one call.
+one device call merges a delta slice into **all** neighbour states at
+once — the BASELINE north-star's 64-neighbour fan-in. The same shape also
+batches a whole gossip round among N chip-resident replicas (each merges
+its ring predecessor's full-row slice) in one call.
+
+All kernels are the row-local binned ops (O(slice) per neighbour, not
+O(capacity) — :mod:`delta_crdt_ex_tpu.ops.binned`).
 """
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
-from delta_crdt_ex_tpu.models.state import DotStore
-from delta_crdt_ex_tpu.ops.join import JoinResult, join
+from delta_crdt_ex_tpu.models.binned import BinnedStore
+from delta_crdt_ex_tpu.ops.binned import (
+    MergeResult,
+    RowSlice,
+    extract_rows,
+    merge_slice,
+)
 
 
-def stack_states(states: list[DotStore]) -> DotStore:
+def stack_states(states: list[BinnedStore]) -> BinnedStore:
     """Stack equally-shaped replica states on a leading neighbour axis."""
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
 
 
-def unstack_states(stacked: DotStore) -> list[DotStore]:
+def unstack_states(stacked: BinnedStore) -> list[BinnedStore]:
     n = stacked.key.shape[0]
     return [jax.tree_util.tree_map(lambda x: x[i], stacked) for i in range(n)]
 
 
-def fanout_join(
-    stacked: DotStore, delta: DotStore, bucket_mask: jnp.ndarray | None = None
-) -> JoinResult:
-    """Join one delta into N stacked neighbour states in one device call.
+@partial(jax.jit, static_argnames=("kill_budget",))
+def fanout_merge(
+    stacked: BinnedStore, sl: RowSlice, kill_budget: int = 64
+) -> MergeResult:
+    """Merge one slice into N stacked neighbour states in one device call.
 
     The reference's per-neighbour sync loop, collapsed into a vmap: each
-    neighbour performs its own context remap + dot-set join against the
-    shared delta (states may know different replica sets — the remap is
+    neighbour performs its own gid remap + interval join against the
+    shared slice (states may know different replica sets — the remap is
     per-neighbour).
     """
-    return jax.vmap(join, in_axes=(0, None, None))(stacked, delta, bucket_mask)
+    return jax.vmap(merge_slice, in_axes=(0, None, None))(stacked, sl, kill_budget)
 
 
-def ring_gossip_round(stacked: DotStore) -> JoinResult:
+@partial(jax.jit, static_argnames=("kill_budget",))
+def ring_gossip_round(stacked: BinnedStore, kill_budget: int = 64) -> MergeResult:
     """One full-state gossip round among N chip-resident replicas: replica
-    i joins replica (i-1) mod N. One device call, N joins."""
+    i merges replica (i-1) mod N's full-row slice. One device call, N
+    merges."""
     rolled = jax.tree_util.tree_map(lambda x: jnp.roll(x, 1, axis=0), stacked)
-    return jax.vmap(join, in_axes=(0, 0, None))(stacked, rolled, None)
-
-
-jit_fanout_join = jax.jit(fanout_join)
-jit_ring_gossip_round = jax.jit(ring_gossip_round)
+    all_rows = jnp.arange(stacked.num_buckets, dtype=jnp.int32)
+    slices = jax.vmap(extract_rows, in_axes=(0, None))(rolled, all_rows)
+    return jax.vmap(merge_slice, in_axes=(0, 0, None))(stacked, slices, kill_budget)

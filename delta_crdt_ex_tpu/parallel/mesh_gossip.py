@@ -2,23 +2,24 @@
 
 The reference scales across machines via distributed Erlang's full-mesh
 TCP (SURVEY §5.8). The TPU-native equivalent keeps one replica state
-resident per device of a ``jax.sharding.Mesh`` and moves whole delta
-states device↔device over ICI with ``lax.ppermute`` inside ``shard_map``
+resident per device of a ``jax.sharding.Mesh`` and moves whole state
+pytrees device↔device over ICI with ``lax.ppermute`` inside ``shard_map``
 — no host hop, XLA schedules the collective. A gossip *step* is:
 
-1. (optional) apply a per-replica local mutation batch (vmapped
-   ``apply_batch`` — the "compute" of the step);
+1. (optional) apply a per-replica local mutation batch (the bucket-
+   grouped ``row_apply`` kernel — the "compute" of the step);
 2. ``ppermute`` the full state pytree one hop around the ring;
-3. join the received state shard-locally;
-4. rebuild digest-tree roots (the observability/convergence probe).
+3. merge the received state's full-row slice shard-locally;
+4. rebuild digest-tree roots from the maintained leaf digests (the
+   observability/convergence probe).
 
 Ring gossip converges every replica in ≤ N-1 steps (each state travels
 the whole ring); anti-entropy idempotence makes over-delivery harmless —
 semantically this is the reference's neighbour gossip with a ring
 topology, executed as one SPMD program.
 
-The entry-slice (bounded-divergence) variant over ICI is layered the
-same way — extract on device, ppermute fixed-size slices, join — and is
+The bounded-divergence variant over ICI is layered the same way —
+extract row slices on device, ppermute fixed-size slices, merge — and is
 what :mod:`delta_crdt_ex_tpu.parallel.batched_sync` does within a chip.
 """
 
@@ -31,10 +32,13 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from delta_crdt_ex_tpu.models.state import DotStore
-from delta_crdt_ex_tpu.ops.apply import apply_batch
-from delta_crdt_ex_tpu.ops.hashtree import digest_tree
-from delta_crdt_ex_tpu.ops.join import join
+from delta_crdt_ex_tpu.models.binned import BinnedStore
+from delta_crdt_ex_tpu.ops.binned import (
+    extract_rows,
+    merge_slice,
+    row_apply,
+    tree_from_leaves,
+)
 
 AXIS = "replicas"
 
@@ -51,7 +55,7 @@ def replica_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(AXIS))
 
 
-def place_states(states: list[DotStore], mesh: Mesh) -> DotStore:
+def place_states(states: list[BinnedStore], mesh: Mesh) -> BinnedStore:
     """Stack N replica states and shard one-per-device over the mesh."""
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
     return jax.device_put(stacked, replica_sharding(mesh))
@@ -65,21 +69,22 @@ def _unsqueeze(tree):
     return jax.tree_util.tree_map(lambda x: x[None], tree)
 
 
-@partial(jax.jit, static_argnames=("mesh", "depth"))
+@partial(jax.jit, static_argnames=("mesh", "kill_budget"))
 def gossip_train_step(
     mesh: Mesh,
-    stacked: DotStore,
-    self_slot: jnp.ndarray,  # int32[N]   each replica's own ctx slot
-    op: jnp.ndarray,  # int32[N, K]  per-replica mutation batches
-    key: jnp.ndarray,  # uint64[N, K]
-    valh: jnp.ndarray,  # uint32[N, K]
-    ts: jnp.ndarray,  # int64[N, K]
-    depth: int = 6,
+    stacked: BinnedStore,
+    self_slot: jnp.ndarray,  # int32[N]     each replica's own ctx slot
+    rows: jnp.ndarray,  # int32[N, U]  bucket-grouped mutation batches
+    op: jnp.ndarray,  # int32[N, U, M]   (see binned_map.group_batch)
+    key: jnp.ndarray,  # uint64[N, U, M]
+    valh: jnp.ndarray,  # uint32[N, U, M]
+    ts: jnp.ndarray,  # int64[N, U, M]
+    kill_budget: int = 64,
 ):
-    """One SPMD step: local mutation batch → ring ppermute → join → roots.
+    """One SPMD step: local mutation batch → ring ppermute → merge → roots.
 
     This is the framework's "training step" shape: per-device compute
-    (batched mutation kernels), one ICI collective (ppermute of the full
+    (row-local mutation kernels), one ICI collective (ppermute of the full
     state pytree), then shard-local lattice math. Returns the new stacked
     states and each replica's digest-tree root (uint32[N]) for
     convergence monitoring.
@@ -88,22 +93,24 @@ def gossip_train_step(
     perm = [(i, (i + 1) % n) for i in range(n)]
     spec = P(AXIS)
 
-    def step(local, slot, op_b, key_b, valh_b, ts_b):
+    def step(local, slot, rows_b, op_b, key_b, valh_b, ts_b):
         local = _squeeze(local)
-        applied = apply_batch(
-            local, slot[0], op_b[0], key_b[0], valh_b[0], ts_b[0]
+        applied = row_apply(
+            local, slot[0], rows_b[0], op_b[0], key_b[0], valh_b[0], ts_b[0]
         ).state
         received = jax.tree_util.tree_map(
             lambda x: jax.lax.ppermute(x, AXIS, perm), applied
         )
-        merged, _ok2, _ins, _kill = join(applied, received, None)
-        root = digest_tree(merged, depth)[0][0]
+        all_rows = jnp.arange(applied.num_buckets, dtype=jnp.int32)
+        sl = extract_rows(received, all_rows)
+        merged = merge_slice(applied, sl, kill_budget).state
+        root = tree_from_leaves(merged.leaf)[0][0]
         return _unsqueeze(merged), root[None]
 
     return shard_map(
         step,
         mesh=mesh,
-        in_specs=(spec, spec, spec, spec, spec, spec),
+        in_specs=(spec, spec, spec, spec, spec, spec, spec),
         out_specs=(spec, spec),
         check_vma=False,
-    )(stacked, self_slot, op, key, valh, ts)
+    )(stacked, self_slot, rows, op, key, valh, ts)

@@ -664,6 +664,8 @@ class Replica:
             ctr=jnp.asarray(a["ctr"]),
             alive=jnp.asarray(a["alive"]),
             ctx_rows=jnp.asarray(a["ctx_rows"]),
+            # anti-entropy ships full-row state slices: interval lo = 0
+            ctx_lo=jnp.zeros_like(jnp.asarray(a["ctx_rows"])),
             ctx_gid=jnp.asarray(a["ctx_gid"]),
         )
         rows_np = a["rows"]

@@ -1,0 +1,156 @@
+"""Synthetic workload builders (benchmarks, graft entry, bulk loads).
+
+Host-side numpy construction of binned states and writer delta streams.
+The synthetic writer issues **per-bucket contiguous counters** so its
+delta stream ships exact delta-intervals (``RowSlice.ctx_lo``): each
+delta claims precisely the dots it carries, older dots stay unclaimed,
+and in-order merging never gaps. Dot identity is (writer gid, bucket,
+counter) — globally unique because a dot's bucket is a function of its
+key. (The replica runtime instead issues one global counter sequence per
+writer — a sparse special case of the same scheme; both are valid dot
+namespaces for the lattice.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from delta_crdt_ex_tpu.models.binned import BinnedStore
+from delta_crdt_ex_tpu.ops.binned import RowSlice, init_from_columns
+
+
+def build_state(
+    gid: int,
+    keys: np.ndarray,
+    num_buckets: int,
+    bin_capacity: int,
+    replica_capacity: int = 8,
+    ts_start: int = 1,
+):
+    """A single-writer BinnedStore holding ``keys`` (uint64, distinct),
+    with per-bucket contiguous counters. Returns (state, next_ctr[L])
+    where ``next_ctr[b] - 1`` is the writer's top counter in bucket b.
+    Invariants (ehash/fill/amin/amax/leaf) are rebuilt on device by
+    :func:`~delta_crdt_ex_tpu.ops.binned.init_from_columns`."""
+    import jax.numpy as jnp
+
+    L, B = num_buckets, bin_capacity
+    n = len(keys)
+    bucket = (keys & np.uint64(L - 1)).astype(np.int64)
+    order = np.argsort(bucket, kind="stable")
+    sk = keys[order]
+    sb = bucket[order]
+    # rank within bucket = per-bucket slot and counter-1
+    starts = np.searchsorted(sb, np.arange(L))
+    rank = np.arange(n) - starts[sb]
+    if rank.max(initial=0) >= B:
+        raise ValueError(
+            f"bucket overflow: max occupancy {rank.max() + 1} > bin capacity {B}"
+        )
+
+    key = np.zeros((L, B), np.uint64)
+    valh = np.zeros((L, B), np.uint32)
+    ts = np.zeros((L, B), np.int64)
+    ctr = np.zeros((L, B), np.uint32)
+    alive = np.zeros((L, B), bool)
+    key[sb, rank] = sk
+    valh[sb, rank] = (sk & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    ts[sb, rank] = ts_start + np.arange(n)
+    ctr[sb, rank] = rank + 1
+    alive[sb, rank] = True
+
+    counts = np.bincount(bucket, minlength=L).astype(np.uint32)
+    ctx_max = np.zeros((L, replica_capacity), np.uint32)
+    ctx_max[:, 0] = counts
+    ctx_gid = np.zeros(replica_capacity, np.uint64)
+    ctx_gid[0] = gid
+
+    raw = BinnedStore(
+        key=jnp.asarray(key),
+        valh=jnp.asarray(valh),
+        ts=jnp.asarray(ts),
+        node=jnp.zeros((L, B), jnp.int32),
+        ctr=jnp.asarray(ctr),
+        alive=jnp.asarray(alive),
+        ehash=jnp.zeros((L, B), jnp.uint32),
+        fill=jnp.zeros(L, jnp.int32),
+        amin=jnp.zeros((L, replica_capacity), jnp.uint32),
+        amax=jnp.zeros((L, replica_capacity), jnp.uint32),
+        leaf=jnp.zeros(L, jnp.uint32),
+        ctx_gid=jnp.asarray(ctx_gid),
+        ctx_max=jnp.asarray(ctx_max),
+    )
+    import jax
+
+    return jax.jit(init_from_columns)(raw), counts.astype(np.uint32) + 1
+
+
+def interval_delta_stream(
+    gid: int,
+    rng: np.random.Generator,
+    num_deltas: int,
+    delta_size: int,
+    num_buckets: int,
+    next_ctr: np.ndarray | None = None,
+    ts_start: int = 1 << 20,
+    bin_width: int = 8,
+):
+    """``num_deltas`` sequential RowSlices from one writer: fresh random
+    keys, per-bucket counters continuing from ``next_ctr``, exact
+    delta-interval contexts. All slices share the static shape
+    [U, bin_width] (U = delta_size padded to a power of two) so a scan
+    over the stream compiles once."""
+    import jax.numpy as jnp
+
+    L = num_buckets
+    next_ctr = (
+        next_ctr.astype(np.uint32) if next_ctr is not None else np.ones(L, np.uint32)
+    )
+    u = 1
+    while u < delta_size:
+        u *= 2
+    s = bin_width
+    slices = []
+    ts = ts_start
+    for _ in range(num_deltas):
+        keys = rng.integers(1, 1 << 63, size=delta_size, dtype=np.uint64)
+        bucket = (keys & np.uint64(L - 1)).astype(np.int64)
+        rows_u, inv = np.unique(bucket, return_inverse=True)
+        nrows = len(rows_u)
+        cols = np.zeros(delta_size, np.int64)
+        seen: dict[int, int] = {}
+        for i in range(delta_size):
+            r = int(inv[i])
+            cols[i] = seen.get(r, 0)
+            seen[r] = cols[i] + 1
+        if max(seen.values()) > s:
+            raise ValueError(
+                f"delta has {max(seen.values())} same-bucket keys > bin_width {s}"
+            )
+
+        sl = dict(
+            rows=np.full(u, -1, np.int32),
+            key=np.zeros((u, s), np.uint64),
+            valh=np.zeros((u, s), np.uint32),
+            ts=np.zeros((u, s), np.int64),
+            node=np.zeros((u, s), np.int32),
+            ctr=np.zeros((u, s), np.uint32),
+            alive=np.zeros((u, s), bool),
+            ctx_rows=np.zeros((u, 1), np.uint32),
+            ctx_lo=np.zeros((u, 1), np.uint32),
+            ctx_gid=np.array([gid], np.uint64),
+        )
+        sl["rows"][:nrows] = rows_u
+        lo = next_ctr[rows_u] - 1  # interval lower bound (exclusive)
+        sl["ctx_lo"][:nrows, 0] = lo
+        counts = np.bincount(inv, minlength=nrows).astype(np.uint32)
+        sl["ctx_rows"][:nrows, 0] = lo + counts
+        sl["key"][inv, cols] = keys
+        sl["valh"][inv, cols] = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        sl["ts"][inv, cols] = ts + np.arange(delta_size)
+        sl["ctr"][inv, cols] = lo[inv] + cols + 1
+        sl["alive"][inv, cols] = True
+        next_ctr[rows_u] += counts
+        ts += delta_size
+        slices.append(RowSlice(**{k: jnp.asarray(v) for k, v in sl.items()}))
+    return slices, next_ctr

@@ -157,12 +157,7 @@ class BinnedKernelMap:
         return res
 
     def read(self) -> dict[int, int]:
-        rows = jnp.arange(self.state.num_buckets, dtype=jnp.int32)
-        w = self.M.winner_rows(self.state, rows)
-        win = np.asarray(w.win)
-        keys = np.asarray(w.key)[win]
-        vals = np.asarray(w.valh)[win]
-        return {int(k): int(v) for k, v in zip(keys, vals)}
+        return read_binned_state(self.state)
 
     def ctx(self) -> dict[int, int]:
         gids = np.asarray(self.state.ctx_gid)
@@ -171,3 +166,15 @@ class BinnedKernelMap:
 
     def alive_count(self) -> int:
         return int(self.state.num_alive())
+
+
+def read_binned_state(state) -> dict[int, int]:
+    """{key: valh} LWW read of a BinnedStore (shared by harnesses/tests)."""
+    from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap
+
+    rows = jnp.arange(state.num_buckets, dtype=jnp.int32)
+    w = BinnedAWLWWMap.winner_rows(state, rows)
+    win = np.asarray(w.win)
+    keys = np.asarray(w.key)[win]
+    vals = np.asarray(w.valh)[win]
+    return {int(k): int(v) for k, v in zip(keys, vals)}

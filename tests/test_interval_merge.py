@@ -1,0 +1,124 @@
+"""Delta-interval merge tests (Almeida et al.'s delta-intervals).
+
+A partial slice whose context is the interval ``(lo, hi]`` claims only
+the dots it ships: older alive dots of the same (bucket, writer) must
+survive the merge, and a non-contiguous interval (a gap beneath ``lo``)
+must be rejected rather than silently over-advancing the context.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap as M
+from delta_crdt_ex_tpu.ops.binned import RowSlice
+from tests.kernel_harness import BinnedKernelMap
+
+L = 64  # num_buckets of the harness default
+WRITER = 777
+
+
+def interval_slice(rows, entries, lo, hi):
+    """Build a single-writer RowSlice by hand: ``entries`` is a list of
+    (row_index_into_rows, key, valh, ts, ctr); interval (lo, hi] per row."""
+    u, s = len(rows), max(len(entries), 1)
+    sl = dict(
+        rows=np.asarray(rows, np.int32),
+        key=np.zeros((u, s), np.uint64),
+        valh=np.zeros((u, s), np.uint32),
+        ts=np.zeros((u, s), np.int64),
+        node=np.zeros((u, s), np.int32),
+        ctr=np.zeros((u, s), np.uint32),
+        alive=np.zeros((u, s), bool),
+        ctx_rows=np.asarray(hi, np.uint32).reshape(u, 1),
+        ctx_lo=np.asarray(lo, np.uint32).reshape(u, 1),
+        ctx_gid=np.array([WRITER], np.uint64),
+    )
+    fill = [0] * u
+    for r, key, valh, ts, ctr in entries:
+        j = fill[r]
+        fill[r] = j + 1
+        sl["key"][r, j] = key
+        sl["valh"][r, j] = valh
+        sl["ts"][r, j] = ts
+        sl["ctr"][r, j] = ctr
+        sl["alive"][r, j] = True
+    return RowSlice(**{k: jnp.asarray(v) for k, v in sl.items()})
+
+
+def test_interval_delta_does_not_kill_older_unshipped_dots():
+    b = BinnedKernelMap(11)
+    bucket = 1
+    k1, k2 = 1, 1 + L  # same bucket
+    # delta 1: writer adds k1 (ctr 1); interval (0, 1]
+    b.merge_slice(interval_slice([bucket], [(0, k1, 10, 1, 1)], [0], [1]))
+    assert b.read() == {k1: 10}
+    # delta 2: writer adds k2 (ctr 2); interval (1, 2] — k1 NOT shipped
+    b.merge_slice(interval_slice([bucket], [(0, k2, 20, 2, 2)], [1], [2]))
+    assert b.read() == {k1: 10, k2: 20}  # k1 survives: not claimed
+
+
+def test_state_form_slice_with_same_content_would_kill():
+    """Contrast case: the same partial content shipped as a state-form
+    slice (lo=0) over-claims and kills the unshipped dot — exactly the
+    unsoundness delta-intervals exist to prevent."""
+    b = BinnedKernelMap(11)
+    bucket = 1
+    k1, k2 = 1, 1 + L
+    b.merge_slice(interval_slice([bucket], [(0, k1, 10, 1, 1)], [0], [1]))
+    b.merge_slice(interval_slice([bucket], [(0, k2, 20, 2, 2)], [0], [2]))
+    assert b.read() == {k2: 20}  # state-form claim (0,2] killed ctr 1
+
+
+def test_interval_gap_is_rejected():
+    b = BinnedKernelMap(11)
+    bucket = 1
+    k1, k3 = 1, 1 + 2 * L
+    b.merge_slice(interval_slice([bucket], [(0, k1, 10, 1, 1)], [0], [1]))
+    # skip ctr 2: interval (2, 3] has a gap beneath it
+    with pytest.raises(ValueError, match="not contiguous"):
+        b.merge_slice(interval_slice([bucket], [(0, k3, 30, 3, 3)], [2], [3]))
+    res = M.merge_slice(
+        b.state, interval_slice([bucket], [(0, k3, 30, 3, 3)], [2], [3]), kill_budget=4
+    )
+    assert bool(res.need_ctx_gap) and not bool(res.ok)
+
+
+def test_interval_removal_propagates():
+    """A delta-interval can also carry a remove: the interval covers the
+    removed dot but the slice does not contain it alive."""
+    b = BinnedKernelMap(11)
+    bucket = 1
+    k1 = 1
+    b.merge_slice(interval_slice([bucket], [(0, k1, 10, 1, 1)], [0], [1]))
+    assert b.read() == {k1: 10}
+    # writer removed k1: interval (0, 1] re-claims dot 1, ships nothing
+    b.merge_slice(interval_slice([bucket], [], [0], [1]))
+    assert b.read() == {}
+
+
+def test_empty_interval_claims_nothing():
+    """An idle writer's row ships lo == hi > 0 (an empty interval): it
+    must not read as a (0, hi] state-form claim — older unshipped dots
+    survive and the local context must not advance."""
+    b = BinnedKernelMap(11)
+    bucket = 1
+    k1 = 1
+    b.merge_slice(interval_slice([bucket], [(0, k1, 10, 1, 1)], [0], [1]))
+    ctx_before = np.asarray(b.state.ctx_max).copy()
+    # empty claim (1, 1]: nothing shipped, nothing claimed
+    b.merge_slice(interval_slice([bucket], [], [1], [1]))
+    assert b.read() == {k1: 10}
+    assert np.array_equal(np.asarray(b.state.ctx_max), ctx_before)
+
+
+def test_interval_merge_is_idempotent():
+    b = BinnedKernelMap(11)
+    bucket = 1
+    sl = interval_slice([bucket], [(0, 1, 10, 1, 1)], [0], [1])
+    b.merge_slice(sl)
+    r1 = b.read()
+    leaf1 = np.asarray(b.state.leaf).copy()
+    b.merge_slice(sl)
+    assert b.read() == r1
+    assert np.array_equal(np.asarray(b.state.leaf), leaf1)

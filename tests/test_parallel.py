@@ -1,21 +1,20 @@
 """Parallel-layer tests on the 8-virtual-device CPU mesh.
 
 Covers the two TPU-native fan-out paths (SURVEY §2.2): the vmapped
-neighbour batch (one call joins all neighbours) and the shard_map ring
-gossip over a Mesh (one replica per device, state moved by ppermute).
+neighbour batch (one call merges a slice into all neighbours) and the
+shard_map ring gossip over a Mesh (one replica per device, state moved
+by ppermute).
 """
-
-import dataclasses
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
-from delta_crdt_ex_tpu.models.state import DotStore
+from delta_crdt_ex_tpu.models.binned import BinnedStore
+from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap, group_batch
 from delta_crdt_ex_tpu.ops.apply import OP_ADD, OP_PAD
 from delta_crdt_ex_tpu.parallel import (
-    fanout_join,
+    fanout_merge,
     gossip_train_step,
     make_mesh,
     place_states,
@@ -23,27 +22,28 @@ from delta_crdt_ex_tpu.parallel import (
     stack_states,
     unstack_states,
 )
-from tests.kernel_harness import KernelMap
+from tests.kernel_harness import BinnedKernelMap, read_binned_state as _read
 
 
 def fresh_states(n, capacity=64, rcap=8, num_buckets=64):
-    maps = []
-    for i in range(n):
-        m = KernelMap(gid=100 + i, capacity=capacity, rcap=rcap, num_buckets=num_buckets)
-        maps.append(m)
-    return maps
+    return [
+        BinnedKernelMap(gid=100 + i, capacity=capacity, rcap=rcap, num_buckets=num_buckets)
+        for i in range(n)
+    ]
 
 
-def test_fanout_join_matches_sequential():
-    """One vmapped call == N sequential joins."""
+def test_fanout_merge_matches_sequential():
+    """One vmapped call == N sequential merges."""
     maps = fresh_states(4)
     for i, m in enumerate(maps):
         m.add(10 + i, i, ts=i + 1)
-    delta_map = KernelMap(gid=999)
+    delta_map = BinnedKernelMap(gid=999)
     delta_map.add(7, 77, ts=100)
+    all_rows = jnp.arange(delta_map.state.num_buckets, dtype=jnp.int32)
+    sl = BinnedAWLWWMap.extract_rows(delta_map.state, all_rows)
 
     stacked = stack_states([m.state for m in maps])
-    res = fanout_join(stacked, delta_map.state, None)
+    res = fanout_merge(stacked, sl)
     assert bool(jnp.all(res.ok))
     outs = unstack_states(res.state)
 
@@ -52,16 +52,6 @@ def test_fanout_join_matches_sequential():
         got = _read(outs[i])
         assert got == m.read()
         assert got[7] == 77
-
-
-def _read(state: DotStore):
-    from delta_crdt_ex_tpu.models.aw_lww_map import AWLWWMap
-
-    w = AWLWWMap.winner_slice(state, None, out_size=state.capacity)
-    count = int(w.count)
-    keys = np.asarray(w.key)[:count]
-    vals = np.asarray(w.valh)[:count]
-    return {int(keys[i]): int(vals[i]) for i in range(count)}
 
 
 def test_ring_gossip_converges_all_replicas():
@@ -79,36 +69,54 @@ def test_ring_gossip_converges_all_replicas():
         assert _read(st) == want
 
 
+def grouped_mutations(n, num_buckets, ops_per_replica):
+    """Stack bucket-grouped mutation batches for gossip_train_step:
+    ``ops_per_replica[i]`` is a list of (op, key, valh, ts)."""
+    groups = []
+    u = m = 1
+    for ops in ops_per_replica:
+        op = np.array([o[0] for o in ops], np.int32)
+        key = np.array([o[1] for o in ops], np.uint64)
+        valh = np.array([o[2] for o in ops], np.uint32)
+        ts = np.array([o[3] for o in ops], np.int64)
+        g = group_batch(num_buckets, op, key, valh, ts)
+        groups.append(g)
+        u = max(u, g.rows.shape[0])
+        m = max(m, g.op.shape[1])
+    rows = np.full((n, u), -1, np.int32)
+    op = np.full((n, u, m), OP_PAD, np.int32)
+    key = np.zeros((n, u, m), np.uint64)
+    valh = np.zeros((n, u, m), np.uint32)
+    ts = np.zeros((n, u, m), np.int64)
+    for i, g in enumerate(groups):
+        gu, gm = g.op.shape
+        rows[i, :gu] = g.rows
+        op[i, :gu, :gm] = g.op
+        key[i, :gu, :gm] = g.key
+        valh[i, :gu, :gm] = g.valh
+        ts[i, :gu, :gm] = g.ts
+    return tuple(map(jnp.asarray, (rows, op, key, valh, ts)))
+
+
 def test_mesh_gossip_train_step_converges():
     """shard_map SPMD step over the 8-device CPU mesh: per-device mutation
-    batch + ppermute ring join; N-1 steps converge all replicas."""
+    batch + ppermute ring merge; N-1 steps converge all replicas."""
     n = len(jax.devices())
     assert n == 8, "conftest must provide 8 virtual cpu devices"
     mesh = make_mesh()
     maps = fresh_states(n, capacity=128)
     stacked = place_states([m.state for m in maps], mesh)
     self_slot = jnp.zeros(n, jnp.int32)
+    num_buckets = maps[0].state.num_buckets
 
-    k = 8
-    op = np.full((n, k), OP_PAD, np.int32)
-    key = np.zeros((n, k), np.uint64)
-    valh = np.zeros((n, k), np.uint32)
-    ts = np.zeros((n, k), np.int64)
-    for i in range(n):
-        op[i, 0] = OP_ADD
-        key[i, 0] = 1000 + i
-        valh[i, 0] = i
-        ts[i, 0] = i + 1
-
-    args = tuple(map(jnp.asarray, (op, key, valh, ts)))
-    stacked, roots = gossip_train_step(mesh, stacked, self_slot, *args, depth=6)
-    # after step 1, keep gossiping with empty batches
-    empty = tuple(
-        map(jnp.asarray, (np.full((n, k), OP_PAD, np.int32), np.zeros((n, k), np.uint64),
-                          np.zeros((n, k), np.uint32), np.zeros((n, k), np.int64)))
+    batches = grouped_mutations(
+        n, num_buckets, [[(OP_ADD, 1000 + i, i, i + 1)] for i in range(n)]
     )
+    stacked, roots = gossip_train_step(mesh, stacked, self_slot, *batches)
+    # after step 1, keep gossiping with empty batches
+    empty = grouped_mutations(n, num_buckets, [[] for _ in range(n)])
     for _ in range(n - 1):
-        stacked, roots = gossip_train_step(mesh, stacked, self_slot, *empty, depth=6)
+        stacked, roots = gossip_train_step(mesh, stacked, self_slot, *empty)
 
     roots = np.asarray(roots)
     assert (roots == roots[0]).all(), "digest roots must agree after full ring"
