@@ -102,10 +102,12 @@ def bench_tpu(seed=0):
 
     @partial_jit_donate
     def merge_chunk(states, sl):
-        res = jax.vmap(merge_slice, in_axes=(0, None, None))(states, sl, 8)
+        res = jax.vmap(merge_slice, in_axes=(0, None, None, None))(
+            states, sl, 8, GROUP * DELTA
+        )
         flags = jnp.stack(
             [res.need_gid_grow, res.need_kill_tier, res.need_fill_compact,
-             res.need_ctx_gap]
+             res.need_ctx_gap, res.need_ins_tier]
         )
         # per-sync-round index refresh (update_hashes analog): tree roots
         roots = jax.vmap(lambda lf: tree_from_leaves(lf)[0][0])(res.state.leaf)
@@ -116,7 +118,7 @@ def bench_tpu(seed=0):
     for i in range(WARMUP_CALLS):
         st, oks, flags, roots = merge_chunk(st, calls[i])
     roots.block_until_ready()
-    assert bool(jnp.all(oks)), f"merge overflow in bench workload: {np.asarray(jnp.any(flags, axis=1)).tolist()} (gid/kill/fill/gap)"
+    assert bool(jnp.all(oks)), f"merge overflow in bench workload: {np.asarray(jnp.any(flags, axis=1)).tolist()} (gid/kill/fill/gap/ins)"
     log("tpu compile+warmup done")
 
     t0 = time.perf_counter()
@@ -130,7 +132,7 @@ def bench_tpu(seed=0):
     dt = time.perf_counter() - t0
     oks = jnp.stack(all_ok)
     flags = jnp.stack(all_flags)
-    assert bool(jnp.all(oks)), f"merge overflow: {np.asarray(jnp.any(flags, axis=(0, 2))).tolist()} (gid/kill/fill/gap)"
+    assert bool(jnp.all(oks)), f"merge overflow: {np.asarray(jnp.any(flags, axis=(0, 2))).tolist()} (gid/kill/fill/gap/ins)"
     merges = CALLS * GROUP * NEIGHBOURS
     log(f"tpu: {merges} merges in {dt:.3f}s")
     return merges / dt

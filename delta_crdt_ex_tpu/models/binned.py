@@ -69,6 +69,15 @@ import jax.numpy as jnp
 U32_MAX = jnp.uint32(0xFFFFFFFF)
 
 
+def pow2_tier(n: int, floor: int = 1) -> int:
+    """Round up to the power-of-two capacity tier (tiers bound kernel
+    recompiles; every static shape in the engine comes through here)."""
+    c = floor
+    while c < n:
+        c *= 2
+    return c
+
+
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=[

@@ -19,25 +19,20 @@ from functools import partial
 import jax
 import numpy as np
 
-from delta_crdt_ex_tpu.models.binned import BinnedStore
+from delta_crdt_ex_tpu.models.binned import BinnedStore, pow2_tier as _pow2
 from delta_crdt_ex_tpu.ops import binned as binned_ops
 from delta_crdt_ex_tpu.ops.apply import OP_ADD, OP_CLEAR, OP_PAD, OP_REMOVE
 
 jit_row_apply = jax.jit(binned_ops.row_apply)
 jit_clear_all = jax.jit(binned_ops.clear_all)
-jit_merge_slice = jax.jit(binned_ops.merge_slice, static_argnames=("kill_budget",))
+jit_merge_slice = jax.jit(
+    binned_ops.merge_slice, static_argnames=("kill_budget", "max_inserts")
+)
 jit_extract_rows = jax.jit(binned_ops.extract_rows)
 jit_winners_for_keys = jax.jit(binned_ops.winners_for_keys)
 jit_winner_rows = jax.jit(binned_ops.winner_rows)
 jit_compact_rows = jax.jit(binned_ops.compact_rows)
 jit_tree_from_leaves = jax.jit(binned_ops.tree_from_leaves)
-
-
-def _pow2(n: int, floor: int = 1) -> int:
-    c = floor
-    while c < n:
-        c *= 2
-    return c
 
 
 class GroupedBatch:
@@ -96,7 +91,9 @@ def group_batch(num_buckets: int, op, key, valh, ts) -> GroupedBatch:
     return GroupedBatch(rows, g_op, g_key, g_valh, g_ts, (urow_of, cols))
 
 
-def merge_into(state: BinnedStore, sl, kill_budget: int = 16, on_grow=None):
+def merge_into(
+    state: BinnedStore, sl, kill_budget: int = 16, on_grow=None, n_alive: int | None = None
+):
     """Merge a :class:`~delta_crdt_ex_tpu.ops.binned.RowSlice` into
     ``state``, handling every ``need_*`` escape hatch: grow the gid table,
     raise the kill-budget tier, compact holes, grow the bin tier. Returns
@@ -107,9 +104,16 @@ def merge_into(state: BinnedStore, sl, kill_budget: int = 16, on_grow=None):
     successful merges create them), so after one compact further fill
     overflows go straight to bin growth.
     """
+    # compact the insert scatter to a power-of-two tier of the slice's
+    # alive count (scatter cost is per index entry; the [U, S] grid is
+    # mostly padding); callers that built the slice from host arrays pass
+    # n_alive to avoid a device->host readback here
+    if n_alive is None:
+        n_alive = int(np.asarray(sl.alive).sum())
+    mi = _pow2(max(n_alive, 1))
     compacted = False
     while True:
-        res = jit_merge_slice(state, sl, kill_budget=kill_budget)
+        res = jit_merge_slice(state, sl, kill_budget=kill_budget, max_inserts=mi)
         if bool(res.ok):
             return res.state, res
         if bool(res.need_ctx_gap):
@@ -126,6 +130,8 @@ def merge_into(state: BinnedStore, sl, kill_budget: int = 16, on_grow=None):
                 on_grow(state)
         if bool(res.need_kill_tier):
             kill_budget = min(kill_budget * 4, int(sl.rows.shape[0]))
+        if bool(res.need_ins_tier):
+            mi = min(mi * 4, int(sl.alive.size))
         if bool(res.need_fill_compact):
             if not compacted:
                 state = jit_compact_rows(state)

@@ -332,11 +332,17 @@ class MergeResult(NamedTuple):
     need_ctx_gap: jnp.ndarray  # bool: delta-interval not contiguous with our
     # context (caller must fall back to a full-row sync; never raised by
     # ctx_lo = 0 state-form slices)
+    need_ins_tier: jnp.ndarray  # bool: inserts exceeded the max_inserts tier
     n_inserted: jnp.ndarray  # int32
     n_killed: jnp.ndarray  # int32
 
 
-def merge_slice(state: BinnedStore, sl: RowSlice, kill_budget: int) -> MergeResult:
+def merge_slice(
+    state: BinnedStore,
+    sl: RowSlice,
+    kill_budget: int,
+    max_inserts: int | None = None,
+) -> MergeResult:
     """Join a received bucket slice into the local state — O(slice) plus
     O(kill_budget · B) for the pruned kill pass.
 
@@ -350,6 +356,13 @@ def merge_slice(state: BinnedStore, sl: RowSlice, kill_budget: int) -> MergeResu
     The kill pass gathers only rows where the ``amin``/``amax`` test
     proves a kill is possible; ``kill_budget`` rows at most (static
     tier), else ``ok=False`` and the host retries with a bigger tier.
+
+    ``max_inserts`` (static tier) compacts the insert scatter: the [U, S]
+    slice grid is mostly padding, and TPU scatter cost is per *index
+    entry*, so the inserts are sort-compacted to ``max_inserts`` sorted
+    unique positions before scattering. ``need_ins_tier`` reports an
+    overflowing tier (caller retries a bigger one). ``None`` scatters the
+    full grid (no compaction).
     """
     L = state.num_buckets
     B = state.bin_capacity
@@ -404,17 +417,43 @@ def merge_slice(state: BinnedStore, sl: RowSlice, kill_budget: int) -> MergeResu
     )
     pos = fill_rows[:, None] + ins_rank  # [U, S] target bin slot
 
-    # overflowing rows (pos >= B) must not clip into valid slots — drop;
-    # ok=False discards the whole result anyway
-    flat = jnp.where(ins & (pos < B), rows_clip[:, None] * B + jnp.clip(pos, 0, B - 1), L * B)
+    # overflowing rows (pos >= B) must not clip into valid slots — drop.
+    # Padding indices are DISTINCT out-of-bounds values (L*B + position):
+    # the compacted scatter promises unique_indices, and duplicated
+    # sentinels would void that promise even though they are dropped
+    pad_idx = L * B + jnp.arange(u * s, dtype=jnp.int64).reshape(u, s)
+    flat = jnp.where(
+        ins & (pos < B), rows_clip[:, None] * B + jnp.clip(pos, 0, B - 1), pad_idx
+    )
     gid_of_entry = sl.ctx_gid[jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1)]
     eh_ins = entry_hash(sl.key, gid_of_entry, sl.ctr, sl.ts, sl.valh)
+    n_inserted = jnp.sum(ins.astype(jnp.int32))
+
+    if max_inserts is None:
+        need_ins_tier = jnp.bool_(False)
+        flat_c = flat.reshape(-1)
+        sel = slice(None)
+        sorted_hint = False
+    else:
+        # sort-compact: real insert positions (ascending) first, padding
+        # (L*B) last — scatters then touch max_inserts sorted unique
+        # indices instead of the full padded grid
+        order = jnp.argsort(flat.reshape(-1))
+        sel = order[: min(max_inserts, flat.size)]
+        flat_c = flat.reshape(-1)[sel]
+        need_ins_tier = n_inserted > sel.shape[0]
+        sorted_hint = True
 
     def put(col, vals):
         return (
             col.reshape(-1)
-            .at[flat.reshape(-1)]
-            .set(vals.reshape(-1), mode="drop")
+            .at[flat_c]
+            .set(
+                vals.reshape(-1)[sel],
+                mode="drop",
+                unique_indices=sorted_hint,
+                indices_are_sorted=sorted_hint,
+            )
             .reshape(L, B)
         )
 
@@ -426,16 +465,29 @@ def merge_slice(state: BinnedStore, sl: RowSlice, kill_budget: int) -> MergeResu
     ehash2 = put(state.ehash, eh_ins)
     alive2 = put(state.alive, ins)
     fill2 = state.fill.at[rows_safe].add(n_ins_row, mode="drop")
-    amin2 = state.amin.at[rows_clip[:, None], ln_clip].min(
-        jnp.where(ins, sl.ctr, U32_MAX), mode="drop"
-    )
-    amax2 = state.amax.at[rows_clip[:, None], ln_clip].max(
-        jnp.where(ins, sl.ctr, jnp.uint32(0)), mode="drop"
-    )
+    if max_inserts is None:
+        amin2 = state.amin.at[rows_clip[:, None], ln_clip].min(
+            jnp.where(ins, sl.ctr, U32_MAX), mode="drop"
+        )
+        amax2 = state.amax.at[rows_clip[:, None], ln_clip].max(
+            jnp.where(ins, sl.ctr, jnp.uint32(0)), mode="drop"
+        )
+    else:
+        rows_c = (flat_c // B).astype(jnp.int32)  # == L (dropped) for padding
+        ln_c = ln_clip.reshape(-1)[sel]
+        ctr_c = sl.ctr.reshape(-1)[sel]
+        amin2 = state.amin.at[rows_c, ln_c].min(ctr_c, mode="drop")
+        amax2 = state.amax.at[rows_c, ln_c].max(ctr_c, mode="drop")
     leaf_add = jnp.sum(jnp.where(ins, eh_ins, jnp.uint32(0)), axis=1, dtype=jnp.uint32)
     leaf2 = state.leaf.at[rows_safe].add(leaf_add, mode="drop")
-    ctx2 = state.ctx_max.at[rows_safe].max(rdense, mode="drop")
-    n_inserted = jnp.sum(ins.astype(jnp.int32))
+    # context union, one scatter per remote writer column (the slice's
+    # writer table is small; a [U, R] row scatter would cost U·R index
+    # entries for mostly-empty rows)
+    ctx2 = state.ctx_max
+    for rr in range(sl.ctx_gid.shape[0]):
+        colr = jnp.where(gids.remap[rr] >= 0, gids.remap[rr], R)
+        vals_r = jnp.where(nonempty[:, rr], sl.ctx_rows[:, rr], jnp.uint32(0))
+        ctx2 = ctx2.at[rows_safe, colr].max(vals_r, mode="drop")
 
     # --- kill pass ((s1∩s2) ∪ (s1∖c2)), pruned by amin/amax ---------------
     # the interval (lo, hi] can only kill a local dot if it overlaps the
@@ -486,7 +538,13 @@ def merge_slice(state: BinnedStore, sl: RowSlice, kill_budget: int) -> MergeResu
     amax3 = amax2.at[k_rows].set(amax_k, mode="drop")
     n_killed = jnp.sum(die.astype(jnp.int32))
 
-    ok = ~(gids.overflow | need_kill_tier | need_fill_compact | need_ctx_gap)
+    ok = ~(
+        gids.overflow
+        | need_kill_tier
+        | need_fill_compact
+        | need_ctx_gap
+        | need_ins_tier
+    )
     new_state = BinnedStore(
         key=key2,
         valh=valh2,
@@ -509,6 +567,7 @@ def merge_slice(state: BinnedStore, sl: RowSlice, kill_budget: int) -> MergeResu
         need_kill_tier,
         need_fill_compact,
         need_ctx_gap,
+        need_ins_tier,
         n_inserted,
         n_killed,
     )

@@ -38,9 +38,12 @@ def unstack_states(stacked: BinnedStore) -> list[BinnedStore]:
     return [jax.tree_util.tree_map(lambda x: x[i], stacked) for i in range(n)]
 
 
-@partial(jax.jit, static_argnames=("kill_budget",))
+@partial(jax.jit, static_argnames=("kill_budget", "max_inserts"))
 def fanout_merge(
-    stacked: BinnedStore, sl: RowSlice, kill_budget: int = 64
+    stacked: BinnedStore,
+    sl: RowSlice,
+    kill_budget: int = 64,
+    max_inserts: int | None = None,
 ) -> MergeResult:
     """Merge one slice into N stacked neighbour states in one device call.
 
@@ -49,7 +52,9 @@ def fanout_merge(
     shared slice (states may know different replica sets — the remap is
     per-neighbour).
     """
-    return jax.vmap(merge_slice, in_axes=(0, None, None))(stacked, sl, kill_budget)
+    return jax.vmap(merge_slice, in_axes=(0, None, None, None))(
+        stacked, sl, kill_budget, max_inserts
+    )
 
 
 @partial(jax.jit, static_argnames=("kill_budget",))

@@ -86,8 +86,11 @@ def gossip_train_step(
     This is the framework's "training step" shape: per-device compute
     (row-local mutation kernels), one ICI collective (ppermute of the full
     state pytree), then shard-local lattice math. Returns the new stacked
-    states and each replica's digest-tree root (uint32[N]) for
-    convergence monitoring.
+    states, each replica's digest-tree root (uint32[N]) for convergence
+    monitoring, and per-replica merge ``ok`` flags (bool[N]) — a False
+    flag means that replica's merge overflowed a tier and its state for
+    this step is invalid (callers must check; growth cannot happen
+    inside the SPMD program).
     """
     n = mesh.devices.size
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -103,14 +106,14 @@ def gossip_train_step(
         )
         all_rows = jnp.arange(applied.num_buckets, dtype=jnp.int32)
         sl = extract_rows(received, all_rows)
-        merged = merge_slice(applied, sl, kill_budget).state
-        root = tree_from_leaves(merged.leaf)[0][0]
-        return _unsqueeze(merged), root[None]
+        res = merge_slice(applied, sl, kill_budget)
+        root = tree_from_leaves(res.state.leaf)[0][0]
+        return _unsqueeze(res.state), root[None], res.ok[None]
 
     return shard_map(
         step,
         mesh=mesh,
         in_specs=(spec, spec, spec, spec, spec, spec, spec),
-        out_specs=(spec, spec),
+        out_specs=(spec, spec, spec),
         check_vma=False,
     )(stacked, self_slot, rows, op, key, valh, ts)

@@ -43,7 +43,7 @@ from delta_crdt_ex_tpu.utils.hashing import (
     value_hash32,
     value_hash32_batch,
 )
-from delta_crdt_ex_tpu.models.binned import BinnedStore
+from delta_crdt_ex_tpu.models.binned import BinnedStore, pow2_tier
 from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap
 from delta_crdt_ex_tpu.ops.apply import OP_ADD, OP_CLEAR, OP_PAD, OP_REMOVE
 from delta_crdt_ex_tpu.runtime import sync as sync_proto, telemetry, tracing
@@ -58,10 +58,7 @@ _SLICE_COLUMNS = ("key", "valh", "ts", "node", "ctr", "alive")
 
 
 def _pow2(n: int, floor: int = 8) -> int:
-    c = floor
-    while c < n:
-        c *= 2
-    return c
+    return pow2_tier(n, floor)
 
 
 class Replica:
@@ -676,7 +673,7 @@ class Replica:
         for _dot, (key_term, _val) in msg.payloads.items():
             self._key_terms[key_hash64(key_term)] = key_term
 
-        self._merge_with_growth(sl)
+        self._merge_with_growth(sl, n_alive=int(np.sum(a["alive"])))
 
         keys_a = self._winner_records_rows(rows_np[rows_np >= 0])
         touched: dict[int, Any] = {}
@@ -701,9 +698,13 @@ class Replica:
     #: possibly containing kills; most sync rounds flag none or few)
     KILL_BUDGET = 16
 
-    def _merge_with_growth(self, sl) -> None:
+    def _merge_with_growth(self, sl, n_alive: int | None = None) -> None:
         self.state, _res = self.model.merge_into(
-            self.state, sl, kill_budget=self.KILL_BUDGET, on_grow=self._grown_telemetry
+            self.state,
+            sl,
+            kill_budget=self.KILL_BUDGET,
+            on_grow=self._grown_telemetry,
+            n_alive=n_alive,
         )
 
     # ------------------------------------------------------------------

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from delta_crdt_ex_tpu.models.binned import BinnedStore
+from delta_crdt_ex_tpu.models.binned import BinnedStore, pow2_tier
 from delta_crdt_ex_tpu.ops.binned import RowSlice, init_from_columns
 
 
@@ -106,9 +106,7 @@ def interval_delta_stream(
     next_ctr = (
         next_ctr.astype(np.uint32) if next_ctr is not None else np.ones(L, np.uint32)
     )
-    u = 1
-    while u < delta_size:
-        u *= 2
+    u = pow2_tier(delta_size)
     s = bin_width
     slices = []
     ts = ts_start

@@ -112,11 +112,13 @@ def test_mesh_gossip_train_step_converges():
     batches = grouped_mutations(
         n, num_buckets, [[(OP_ADD, 1000 + i, i, i + 1)] for i in range(n)]
     )
-    stacked, roots = gossip_train_step(mesh, stacked, self_slot, *batches)
+    stacked, roots, oks = gossip_train_step(mesh, stacked, self_slot, *batches)
+    assert bool(oks.all())
     # after step 1, keep gossiping with empty batches
     empty = grouped_mutations(n, num_buckets, [[] for _ in range(n)])
     for _ in range(n - 1):
-        stacked, roots = gossip_train_step(mesh, stacked, self_slot, *empty)
+        stacked, roots, oks = gossip_train_step(mesh, stacked, self_slot, *empty)
+        assert bool(oks.all())
 
     roots = np.asarray(roots)
     assert (roots == roots[0]).all(), "digest roots must agree after full ring"
