@@ -76,7 +76,8 @@ def bench_tpu(seed=0):
     import jax
     import jax.numpy as jnp
 
-    from delta_crdt_ex_tpu.ops.binned import merge_slice, tree_from_leaves
+    from delta_crdt_ex_tpu.ops.binned import merge_slice
+    from delta_crdt_ex_tpu.ops.pallas_tree import batched_roots_fn
     from delta_crdt_ex_tpu.utils.synth import build_state, interval_delta_stream
 
     log(f"jax devices: {jax.devices()}")
@@ -100,6 +101,12 @@ def bench_tpu(seed=0):
         )
         calls.append(slices[0])
 
+    # the digest-tree fold: fused Pallas kernel (whole batch, all levels
+    # in VMEM, one launch) when TPU lowering is available, else the
+    # per-level XLA fold
+    roots_of, tree_impl = batched_roots_fn(1 << TREE_DEPTH)
+    log(f"digest tree: {tree_impl}")
+
     @partial_jit_donate
     def merge_chunk(states, sl):
         res = jax.vmap(merge_slice, in_axes=(0, None, None, None))(
@@ -110,7 +117,7 @@ def bench_tpu(seed=0):
              res.need_ctx_gap, res.need_ins_tier]
         )
         # per-sync-round index refresh (update_hashes analog): tree roots
-        roots = jax.vmap(lambda lf: tree_from_leaves(lf)[0][0])(res.state.leaf)
+        roots = roots_of(res.state.leaf)
         return res.state, res.ok, flags, roots
 
     # warmup / compile

@@ -1,0 +1,106 @@
+"""Pallas TPU kernel: fused digest-tree build from leaf digests.
+
+``tree_from_leaves`` (the ``MerkleMap.update_hashes`` analog,
+``causal_crdt.ex:254``) folds the maintained leaf digests into parent
+levels. The XLA version materialises every level as a separate HBM
+array with one fusion per level — log2(L) kernel launches and HBM round
+trips. The whole working set is tiny (a replica's leaf array at
+L = 2^14 is 64 KB), so the Pallas kernel keeps the entire fold in VMEM:
+one launch computes all levels of a *batch* of trees (the vmapped
+neighbour axis of the bench) and writes the packed parent levels once.
+
+Layout: parent levels are packed into one ``uint32[N, L]`` output —
+level d (size 2^d, d = depth-1 … 0) lives at offset ``2^d`` … ``2^(d+1)``
+(heap order: node i of level d at index ``2^d + i``; index 1 = root,
+index 0 unused). The level-combine mix matches
+:func:`delta_crdt_ex_tpu.ops.binned.tree_from_leaves` bit for bit, so
+either implementation can serve the sync walk.
+
+Falls back to the XLA path transparently where Pallas TPU lowering is
+unavailable (CPU tests run the interpreter instead).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# plain ints (not traced jnp scalars): pallas kernels may not capture
+# array constants, and uint32 arithmetic promotes python ints exactly
+_P1 = 0x85EBCA6B
+_P2 = 0xC2B2AE35
+_GOLDEN = 0x9E3779B9
+
+
+def _mix32(x):
+    x = (x ^ (x >> 16)) * jnp.uint32(_P1)
+    x = (x ^ (x >> 13)) * jnp.uint32(_P2)
+    return x ^ (x >> 16)
+
+
+def _combine(left, right):
+    return (
+        _mix32(left ^ jnp.uint32(_P1))
+        + (_mix32(right ^ jnp.uint32(_P2)) << 1)
+        + jnp.uint32(_GOLDEN)
+    )
+
+
+def _tree_kernel(leaf_ref, out_ref):
+    """One grid program folds one tree entirely in VMEM.
+
+    The fold works on a [1, W] row per level (TPU wants ≥2D vectors);
+    splitting even/odd lanes via a reshape to [W/2, 2] keeps every step
+    a dense VPU op.
+    """
+    cur = leaf_ref[0, :]  # [L]
+    L = cur.shape[0]
+    w = L
+    # write packed levels progressively: level sizes L/2, L/4, …, 1
+    while w > 1:
+        pairs = cur.reshape(w // 2, 2)
+        cur = _combine(pairs[:, 0], pairs[:, 1])  # [w/2]
+        w //= 2
+        out_ref[0, w : 2 * w] = cur
+    out_ref[0, 0:1] = cur  # index 0 unused; keep deterministic
+
+
+def tree_from_leaves_pallas(leaf: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
+    """Packed parent levels ``uint32[N, L]`` for a batch of leaf arrays
+    ``uint32[N, L]`` (heap order, root at index 1). One kernel launch for
+    the whole batch; each grid program folds one tree in VMEM."""
+    from jax.experimental import pallas as pl
+
+    n, L = leaf.shape
+    return pl.pallas_call(
+        _tree_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, L), jnp.uint32),
+        grid=(n,),
+        in_specs=[pl.BlockSpec((1, L), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((1, L), lambda i: (i, 0)),
+        interpret=interpret,
+    )(leaf)
+
+
+def unpack_levels(packed: jnp.ndarray, depth: int) -> list[jnp.ndarray]:
+    """Heap-packed parent levels → the list-of-levels shape the sync walk
+    consumes (root first), for ONE tree ``uint32[L]``. The leaf level is
+    not in ``packed``; append the original leaves."""
+    return [packed[(1 << d) : (1 << (d + 1))] for d in range(depth)]
+
+
+def batched_roots_fn(num_leaves: int):
+    """Probe Pallas availability once and return a jittable
+    ``uint32[N, L] -> uint32[N]`` batched-roots function: the fused
+    kernel where it lowers, the per-level XLA fold elsewhere."""
+    import jax
+
+    from delta_crdt_ex_tpu.ops.binned import tree_from_leaves as xla_tree
+
+    try:
+        jax.jit(tree_from_leaves_pallas)(
+            jnp.zeros((2, num_leaves), jnp.uint32)
+        ).block_until_ready()
+        return lambda leaf: tree_from_leaves_pallas(leaf)[:, 1], "pallas"
+    except Exception:
+        return jax.vmap(lambda lf: xla_tree(lf)[0][0]), "xla"
