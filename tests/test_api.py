@@ -1,0 +1,98 @@
+"""Public API parity tests — the ``DeltaCrdt`` facade surface
+(``lib/delta_crdt.ex``) plus runtime extensions.
+"""
+
+import pytest
+
+from delta_crdt_ex_tpu import AWLWWMap
+from delta_crdt_ex_tpu.api import child_spec, start_link
+from delta_crdt_ex_tpu.runtime import telemetry
+from tests.conftest import converge
+
+
+def mk(transport, clock, **opts):
+    opts.setdefault("capacity", 64)
+    opts.setdefault("tree_depth", 6)
+    return start_link(
+        AWLWWMap, threaded=False, transport=transport, clock=clock, **opts
+    )
+
+
+def test_child_spec_requires_crdt():
+    """Reference raises without a :crdt option (``delta_crdt.ex:73-79``)."""
+    with pytest.raises(ValueError, match="must specify 'crdt'"):
+        child_spec({})
+    spec = child_spec({"crdt": AWLWWMap, "name": "sup_child", "shutdown": 1.0})
+    assert spec["id"] == "sup_child"
+    fn, args, opts = spec["start"]
+    assert fn is start_link and args == (AWLWWMap,)
+    assert "shutdown" not in opts  # consumed by the spec, not forwarded
+
+
+def test_unknown_op_and_wrong_arity_raise(transport, shared_clock):
+    c = mk(transport, shared_clock)
+    with pytest.raises(ValueError, match="unknown operation"):
+        c.mutate("bogus", [1])
+    with pytest.raises(ValueError, match="expects 2 argument"):
+        c.mutate("add", ["only-key"])
+
+
+def test_read_keys_partial_read(transport, shared_clock):
+    """``AWLWWMap.read/2`` partial read (``aw_lww_map.ex:218-224``)."""
+    c = mk(transport, shared_clock)
+    for i in range(10):
+        c.mutate_async("add", [f"k{i}", i])
+    got = c.read_keys(["k3", "k7", "missing"])
+    assert got == {"k3": 3, "k7": 7}
+
+
+def test_read_items_supports_unhashable_keys(transport, shared_clock):
+    c1 = mk(transport, shared_clock)
+    c2 = mk(transport, shared_clock)
+    c1.set_neighbours([c2])
+    c1.mutate("add", [["list", "key"], "v1"])  # lists are unhashable in python
+    c1.mutate("add", [{"dict": "key"}, "v2"])
+    converge(transport, [c1, c2])
+    items = sorted(c2.read_items(), key=repr)
+    assert items == sorted(
+        [(["list", "key"], "v1"), ({"dict": "key"}, "v2")], key=repr
+    )
+    with pytest.raises(TypeError, match="unhashable"):
+        c2.read()
+
+
+def test_capacity_grown_telemetry_fires(transport, shared_clock):
+    events = []
+
+    def rec(event, meas, meta):
+        events.append((meas["capacity"], meas["replica_capacity"]))
+
+    telemetry.attach(telemetry.CAPACITY_GROWN, rec)
+    try:
+        c = mk(transport, shared_clock, capacity=64, tree_depth=3)  # 8 buckets x 8 bins
+        for i in range(200):
+            c.mutate_async("add", [i, i])
+        c.flush()
+        assert len(c.read()) == 200
+        assert events, "growth must fire telemetry"
+        assert events[-1][0] >= 256
+    finally:
+        telemetry.detach(telemetry.CAPACITY_GROWN, rec)
+
+
+def test_sync_round_telemetry_reports_merge(transport, shared_clock):
+    rounds = []
+
+    def rec(event, meas, meta):
+        rounds.append(meas)
+
+    telemetry.attach(telemetry.SYNC_ROUND, rec)
+    try:
+        c1 = mk(transport, shared_clock)
+        c2 = mk(transport, shared_clock)
+        c1.set_neighbours([c2])
+        c1.mutate("add", ["x", 1])
+        converge(transport, [c1, c2])
+        assert any(r["entries"] >= 1 for r in rounds)
+    finally:
+        telemetry.detach(telemetry.SYNC_ROUND, rec)
