@@ -7,9 +7,12 @@ reference ``lib/delta_crdt.ex:9``).
 
 Architecture (TPU-first, NOT a translation of the actor design):
 
-- **Lattice** (:mod:`delta_crdt_ex_tpu.models.aw_lww_map`): the replica's
-  dot store lives in HBM as a struct-of-arrays tensor state; join / LWW
-  read / batched mutation are fused XLA kernels.
+- **Lattice** (:mod:`delta_crdt_ex_tpu.models.binned_map`): the replica's
+  dot store lives in HBM as a bucket-binned struct-of-arrays tensor state;
+  join / LWW read / batched mutation are fused row-local XLA kernels
+  (:mod:`delta_crdt_ex_tpu.ops.binned`). The superseded flat engine
+  (:mod:`delta_crdt_ex_tpu.models.aw_lww_map`) survives only as the
+  cross-validation oracle in the lattice property tests.
 - **Sync index** (:mod:`delta_crdt_ex_tpu.ops.hashtree`): the merkle tree
   becomes a device-resident digest tree with commutative per-bucket
   digests; the reference's continuation ping-pong becomes a

@@ -1,4 +1,32 @@
-from delta_crdt_ex_tpu.models.aw_lww_map import AWLWWMap
-from delta_crdt_ex_tpu.models.state import DotStore
+"""Model classes. The production engine is the bucket-binned
+``BinnedAWLWWMap`` (exported as ``AWLWWMap``, matching the package-level
+export in :mod:`delta_crdt_ex_tpu` and :mod:`delta_crdt_ex_tpu.api`).
 
-__all__ = ["AWLWWMap", "DotStore"]
+The superseded flat engine is kept *only* as a cross-validation oracle
+for the lattice property tests (``tests/test_lattice.py``); it loads
+lazily as ``FlatAWLWWMap`` so production imports never pull in the flat
+kernel chain.
+"""
+
+from delta_crdt_ex_tpu.models.binned import BinnedStore
+from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap
+from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap as AWLWWMap
+
+__all__ = ["AWLWWMap", "BinnedAWLWWMap", "BinnedStore", "DotStore", "FlatAWLWWMap"]
+
+_LAZY = {
+    "FlatAWLWWMap": ("delta_crdt_ex_tpu.models.aw_lww_map", "AWLWWMap"),
+    "DotStore": ("delta_crdt_ex_tpu.models.state", "DotStore"),
+}
+
+
+def __getattr__(name):
+    import importlib
+
+    try:
+        module, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}") from None
+    value = getattr(importlib.import_module(module), attr)
+    globals()[name] = value
+    return value

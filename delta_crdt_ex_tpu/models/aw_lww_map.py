@@ -1,6 +1,13 @@
-"""AWLWWMap — the Add-Wins Last-Write-Wins observed-remove map model.
+"""AWLWWMap (flat engine) — SUPERSEDED; kept as a cross-validation oracle.
 
-This is the TPU-native counterpart of the reference's pluggable
+The production engine is :class:`delta_crdt_ex_tpu.models.binned_map.
+BinnedAWLWWMap`; this flat dot-store engine is retained *only* so the
+lattice property suite (``tests/test_lattice.py``) can cross-check two
+independent kernel implementations against the Python oracle. It is not
+exported on any public path (import explicitly as
+``delta_crdt_ex_tpu.models.FlatAWLWWMap``).
+
+This was the original TPU-native counterpart of the reference's pluggable
 ``crdt_module`` (``DeltaCrdt.AWLWWMap``, ``aw_lww_map.ex``): it bundles the
 empty state constructor, the mutation-op vocabulary, and jit-compiled
 entry points for the lattice kernels. The replica runtime

@@ -363,14 +363,7 @@ class Replica:
                 touched_all.setdefault(kh, self._key_terms.get(kh))
             self._emit_diffs(touched_all, w_before, w_after)
         else:
-            self._tree = None
-            self._read_cache = None
-            if telemetry.has_handlers(telemetry.SYNC_DONE):
-                telemetry.execute(
-                    telemetry.SYNC_DONE,
-                    {"keys_updated_count": n_changed},
-                    {"name": self.name},
-                )
+            self._note_state_changed(lambda: n_changed)
         self._persist()
 
     def _apply_segment(self, op, key, valh, ts, ctr_out) -> int:
@@ -450,6 +443,19 @@ class Replica:
                 out[int(key[i])] = (int(gid[i]), int(ctr[i]), int(valh[i]), int(ts[i]))
         return out
 
+    def _note_state_changed(self, count_fn: Callable[[], int]) -> None:
+        """Invalidate read/tree caches and emit ``SYNC_DONE`` telemetry.
+        ``count_fn`` runs only when a handler is attached — the count may
+        require a device→host readback."""
+        self._tree = None
+        self._read_cache = None
+        if telemetry.has_handlers(telemetry.SYNC_DONE):
+            telemetry.execute(
+                telemetry.SYNC_DONE,
+                {"keys_updated_count": int(count_fn())},
+                {"name": self.name},
+            )
+
     def _emit_diffs(self, touched: dict[int, Any], before: dict, after: dict) -> None:
         """Reference emission rules (``causal_crdt.ex:344-381``): telemetry
         counts internal (dot-level) changes; the user callback compares
@@ -472,13 +478,7 @@ class Replica:
             else:
                 diffs.append(("add", term, new_val))
 
-        self._tree = None
-        self._read_cache = None
-        telemetry.execute(
-            telemetry.SYNC_DONE,
-            {"keys_updated_count": internal_changed},
-            {"name": self.name},
-        )
+        self._note_state_changed(lambda: internal_changed)
         if diffs and self.on_diffs is not None:
             if isinstance(self.on_diffs, tuple):
                 fn, extra = self.on_diffs
@@ -667,22 +667,34 @@ class Replica:
         )
         rows_np = a["rows"]
 
-        keys_b = self._winner_records_rows(rows_np[rows_np >= 0])
+        # the before/after winner passes are an O(U·B²) device compare per
+        # synced bucket set — they exist only to feed the on_diffs callback
+        # (reference: diff work feeds the callback, causal_crdt.ex:344-381);
+        # without a subscriber, telemetry is fed from the merge kernel's own
+        # insert/kill counts instead
+        want_diffs = self.on_diffs is not None
+        keys_b = self._winner_records_rows(rows_np[rows_np >= 0]) if want_diffs else {}
         # payloads first: diff values for incoming winners must resolve
         self._payloads.update(msg.payloads)
         for _dot, (key_term, _val) in msg.payloads.items():
             self._key_terms[key_hash64(key_term)] = key_term
 
-        self._merge_with_growth(sl, n_alive=int(np.sum(a["alive"])))
+        res = self._merge_with_growth(sl, n_alive=int(np.sum(a["alive"])))
 
-        keys_a = self._winner_records_rows(rows_np[rows_np >= 0])
-        touched: dict[int, Any] = {}
-        for kh in set(keys_b) | set(keys_a):
-            term = self._key_terms.get(kh)
-            if term is not None:
-                touched[kh] = term
         self._seq += 1
-        self._emit_diffs(touched, keys_b, keys_a)
+        if want_diffs:
+            keys_a = self._winner_records_rows(rows_np[rows_np >= 0])
+            touched: dict[int, Any] = {}
+            for kh in set(keys_b) | set(keys_a):
+                term = self._key_terms.get(kh)
+                if term is not None:
+                    touched[kh] = term
+            self._emit_diffs(touched, keys_b, keys_a)
+        else:
+            # dot-level changed count (may count a key twice when a merge
+            # both inserts a winner and kills a superseded entry — a
+            # documented approximation of the reference's per-key diff count)
+            self._note_state_changed(lambda: int(res.n_inserted) + int(res.n_killed))
         telemetry.execute(
             telemetry.SYNC_ROUND,
             {
@@ -698,14 +710,15 @@ class Replica:
     #: possibly containing kills; most sync rounds flag none or few)
     KILL_BUDGET = 16
 
-    def _merge_with_growth(self, sl, n_alive: int | None = None) -> None:
-        self.state, _res = self.model.merge_into(
+    def _merge_with_growth(self, sl, n_alive: int | None = None):
+        self.state, res = self.model.merge_into(
             self.state,
             sl,
             kill_budget=self.KILL_BUDGET,
             on_grow=self._grown_telemetry,
             n_alive=n_alive,
         )
+        return res
 
     # ------------------------------------------------------------------
     # bench parity helpers (reference BenchmarkHelper, benchmark_helper.ex:
