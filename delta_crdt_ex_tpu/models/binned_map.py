@@ -91,6 +91,15 @@ def group_batch(num_buckets: int, op, key, valh, ts) -> GroupedBatch:
     return GroupedBatch(rows, g_op, g_key, g_valh, g_ts, (urow_of, cols))
 
 
+class CtxGapError(ValueError):
+    """A delta-interval slice is not contiguous with the local context
+    (``need_ctx_gap``): growth cannot heal this — the *sender* must fall
+    back to a full-row (state-form, ``ctx_lo=0``) slice. A distinct type
+    so sync layers that ship delta-intervals can catch it and request the
+    fallback. (The host runtime currently always ships ``ctx_lo=0``
+    state-form slices, so no catcher exists there yet.)"""
+
+
 def merge_into(
     state: BinnedStore, sl, kill_budget: int = 16, on_grow=None, n_alive: int | None = None
 ):
@@ -117,10 +126,7 @@ def merge_into(
         if bool(res.ok):
             return res.state, res
         if bool(res.need_ctx_gap):
-            # a delta-interval slice below our observed horizon — growth
-            # cannot heal this; the sender must fall back to a full-row
-            # (state-form) slice
-            raise ValueError(
+            raise CtxGapError(
                 "delta-interval slice is not contiguous with the local "
                 "context; re-sync with a full-row slice (ctx_lo=0)"
             )

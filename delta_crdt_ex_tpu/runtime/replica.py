@@ -48,7 +48,7 @@ from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap
 from delta_crdt_ex_tpu.ops.apply import OP_ADD, OP_CLEAR, OP_PAD, OP_REMOVE
 from delta_crdt_ex_tpu.runtime import sync as sync_proto, telemetry, tracing
 from delta_crdt_ex_tpu.runtime.clock import Clock
-from delta_crdt_ex_tpu.runtime.storage import Snapshot, Storage
+from delta_crdt_ex_tpu.runtime.storage import CURRENT_LAYOUT, Snapshot, Storage
 from delta_crdt_ex_tpu.runtime.transport import Down, LocalTransport, default_transport
 
 logger = logging.getLogger("delta_crdt_ex_tpu")
@@ -164,6 +164,13 @@ class Replica:
     # rehydrate / persist (reference causal_crdt.ex:216-250)
 
     def _rehydrate(self, snap: Snapshot) -> None:
+        layout = getattr(snap, "layout", "<untagged>")
+        if layout != CURRENT_LAYOUT:
+            raise ValueError(
+                f"snapshot for {self.name!r} was written by engine layout "
+                f"{layout!r}; this build reads {CURRENT_LAYOUT!r} — "
+                "migrate or delete the stored snapshot to start fresh"
+            )
         self.node_id = snap.node_id
         self._seq = snap.sequence_number
         self.state = BinnedStore(**{c: jnp.asarray(snap.arrays[c]) for c in _COLUMNS})

@@ -25,6 +25,11 @@ from typing import Any, Protocol
 import numpy as np
 
 
+#: engine layout written by the current replica driver; bumped whenever
+#: the column set / array shapes change incompatibly
+CURRENT_LAYOUT = "binned-v1"
+
+
 @dataclasses.dataclass
 class Snapshot:
     """Host-side image of a replica: device arrays + host dictionaries."""
@@ -35,6 +40,7 @@ class Snapshot:
     payloads: dict[tuple[int, int], tuple[Any, Any]]  # dot -> (key_term, value)
     key_terms: dict[int, Any]  # key hash -> key term
     last_ts: int  # clock continuity (LWW monotonicity)
+    layout: str = CURRENT_LAYOUT  # engine layout tag (rehydrate checks it)
 
 
 class Storage(Protocol):

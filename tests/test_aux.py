@@ -107,3 +107,22 @@ def test_file_storage_rehydrates_across_processes(tmp_path, transport, shared_cl
         c2.sync_to_all()
         transport.pump()
     assert c3.read() == c2.read()
+
+
+def test_rehydrate_rejects_foreign_layout(transport, shared_clock):
+    """A snapshot written by a different engine layout must fail with a
+    descriptive error, not an opaque KeyError (ADVICE r1)."""
+    import dataclasses
+
+    import pytest
+
+    store = MemoryStorage()
+    c = mk(transport, shared_clock, name="laytag", storage_module=store)
+    c.mutate("add", ["k", "v"])
+    snap = store.read("laytag")
+    assert snap.layout == "binned-v1"
+    c.stop()
+    c.transport.unregister("laytag")
+    store.write("laytag", dataclasses.replace(snap, layout="flat-v0"))
+    with pytest.raises(ValueError, match="engine layout"):
+        mk(transport, shared_clock, name="laytag", storage_module=store)
