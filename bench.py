@@ -72,27 +72,39 @@ def make_workload(seed=0):
 # ---------------------------------------------------------------------------
 # TPU side
 
+def _stage(name):
+    log(f"[bench +{time.perf_counter() - _T_START:.1f}s] {name}")
+
+
+_T_START = time.perf_counter()
+
+
 def bench_tpu(seed=0):
     import jax
     import jax.numpy as jnp
 
     from delta_crdt_ex_tpu.ops.binned import merge_slice
-    from delta_crdt_ex_tpu.ops.pallas_tree import batched_roots_fn
     from delta_crdt_ex_tpu.utils.synth import build_state, interval_delta_stream
 
+    _stage("importing jax / claiming device…")
     log(f"jax devices: {jax.devices()}")
     L, rng, keys = make_workload(seed)
 
+    _stage("build_state (host arrays + init_from_columns compile)…")
     one, _ = build_state(11, keys, num_buckets=L, bin_capacity=BIN_CAP,
                          replica_capacity=RCAP)
+    jax.block_until_ready(one)
+    _stage("broadcast to neighbour stack…")
     stacked = jax.tree_util.tree_map(
         lambda x: jnp.broadcast_to(x, (NEIGHBOURS,) + x.shape), one
     )
     stacked = jax.tree_util.tree_map(jnp.copy, stacked)
+    jax.block_until_ready(stacked)
 
     # delta streams from a second writer (gid 22): one GROUP-slice join
     # per device call (a group of GROUP in-order 512-entry interval
     # deltas concatenates into one exact interval slice), fresh dots
+    _stage("delta stream generation…")
     next_ctr = None
     calls = []
     for _ in range(WARMUP_CALLS + CALLS):
@@ -103,8 +115,10 @@ def bench_tpu(seed=0):
 
     # the digest-tree fold: fused Pallas kernel (whole batch, all levels
     # in VMEM, one launch) when TPU lowering is available, else the
-    # per-level XLA fold
-    roots_of, tree_impl = batched_roots_fn(1 << TREE_DEPTH)
+    # per-level XLA fold. The probe compile can wedge on experimental
+    # backends (remote-compile relays), so it gets its own watchdog.
+    _stage("digest-tree impl probe…")
+    roots_of, tree_impl = _probed_roots_fn(1 << TREE_DEPTH)
     log(f"digest tree: {tree_impl}")
 
     @partial_jit_donate
@@ -121,12 +135,13 @@ def bench_tpu(seed=0):
         return res.state, res.ok, flags, roots
 
     # warmup / compile
+    _stage("merge_chunk compile + warmup…")
     st = stacked
     for i in range(WARMUP_CALLS):
         st, oks, flags, roots = merge_chunk(st, calls[i])
     roots.block_until_ready()
     assert bool(jnp.all(oks)), f"merge overflow in bench workload: {np.asarray(jnp.any(flags, axis=1)).tolist()} (gid/kill/fill/gap/ins)"
-    log("tpu compile+warmup done")
+    _stage("compile+warmup done; timing…")
 
     t0 = time.perf_counter()
     all_ok = []
@@ -149,6 +164,36 @@ def partial_jit_donate(fn):
     import jax
 
     return jax.jit(fn, donate_argnums=(0,))
+
+
+def _probed_roots_fn(num_leaves: int):
+    """Pick the digest-tree impl with a compile watchdog.
+
+    ``batched_roots_fn`` probes Pallas by compiling the kernel; on an
+    experimental remote-compile backend that probe can hang rather than
+    raise. Run it in a daemon thread and fall back to the per-level XLA
+    fold if it doesn't finish within BENCH_PALLAS_TIMEOUT seconds (the
+    hung thread is abandoned — it holds no locks the XLA path needs)."""
+    import threading
+
+    import jax
+
+    from delta_crdt_ex_tpu.ops.binned import tree_from_leaves as xla_tree
+    from delta_crdt_ex_tpu.ops.pallas_tree import batched_roots_fn
+
+    timeout = float(os.environ.get("BENCH_PALLAS_TIMEOUT", "300"))
+    result = {}
+
+    def probe():
+        result["fn"] = batched_roots_fn(num_leaves)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout)
+    if "fn" in result:
+        return result["fn"]
+    log(f"pallas probe did not finish in {timeout:.0f}s — using XLA fold")
+    return jax.vmap(lambda lf: xla_tree(lf)[0][0]), "xla (probe timeout)"
 
 
 # ---------------------------------------------------------------------------
@@ -209,48 +254,96 @@ def bench_python(seed=0):
     return merges / dt
 
 
-def _device_backend_usable(timeout_s: float = 120.0) -> bool:
+def _device_backend_usable(timeout_s: float, attempts: int) -> bool:
     """Probe whether the configured accelerator backend can initialise.
 
     Device init goes through an external claim that can hang indefinitely
-    when the pool is wedged; probing in a subprocess with a watchdog keeps
-    the bench from hanging the driver. Falls back to CPU (clearly
-    labelled) when the accelerator is unreachable.
+    when the pool is wedged (a killed holder's grant can take a long time
+    to expire) — probe in a subprocess with a watchdog, retrying so a
+    recovering claim still gets picked up.
     """
     import subprocess
 
     if os.environ.get("JAX_PLATFORMS", "") in ("cpu", ""):
         return True
+    for attempt in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout_s,
+                capture_output=True,
+            )
+            if proc.returncode == 0:
+                return True
+            log(f"device claim probe failed (attempt {attempt + 1}/{attempts}): "
+                f"{proc.stderr.decode(errors='replace')[-300:]}")
+        except subprocess.TimeoutExpired:
+            log(f"device claim probe timed out after {timeout_s:.0f}s "
+                f"(attempt {attempt + 1}/{attempts}) — claim may be wedged")
+    return False
+
+
+def _run_tpu_child(env: dict, timeout_s: float) -> float | None:
+    """Run the device side (``--tpu-child``) in a subprocess with a hard
+    watchdog; returns merges/sec or None. The child claims the device,
+    so the parent never imports jax and cannot wedge."""
+    import subprocess
+
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [sys.executable, os.path.abspath(__file__), "--tpu-child"],
             timeout=timeout_s,
+            env=env,
             capture_output=True,
         )
-        return proc.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    except subprocess.TimeoutExpired as e:
+        sys.stderr.buffer.write(e.stderr or b"")
+        log(f"device bench child exceeded {timeout_s:.0f}s watchdog — killed")
+        return None
+    sys.stderr.buffer.write(proc.stderr)
+    if proc.returncode != 0:
+        log(f"device bench child failed (exit {proc.returncode})")
+        return None
+    try:
+        return float(json.loads(proc.stdout.decode().strip().splitlines()[-1])["merges_per_sec"])
+    except (ValueError, KeyError, IndexError):
+        log(f"device bench child printed no result: {proc.stdout[-300:]!r}")
+        return None
 
 
 def main():
-    fallback = os.environ.get("BENCH_FORCED_CPU") == "1"
-    if not fallback and not _device_backend_usable():
-        # the accelerator boot hook runs at interpreter start and taints
-        # `import jax` in THIS process too — a clean re-exec with a
-        # scrubbed env is the only reliable fallback
-        log("accelerator backend unreachable — re-exec on CPU (labelled)")
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["PALLAS_AXON_POOL_IPS"] = ""
-        env["BENCH_FORCED_CPU"] = "1"
-        os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+    if "--tpu-child" in sys.argv:
+        print(json.dumps({"merges_per_sec": bench_tpu()}), flush=True)
+        return
 
     log(
         f"workload: {N_KEYS} keys, {NEIGHBOURS} neighbours, {DELTA}-entry "
         f"delta-interval slices, L=2^{TREE_DEPTH} buckets"
     )
     py = bench_python()
-    tpu = bench_tpu()
+
+    claim_timeout = float(os.environ.get("BENCH_CLAIM_TIMEOUT", "300"))
+    claim_attempts = int(os.environ.get("BENCH_CLAIM_ATTEMPTS", "3"))
+    tpu_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", "2400"))
+
+    value = None
+    fallback = os.environ.get("BENCH_FORCED_CPU") == "1"
+    if not fallback and _device_backend_usable(claim_timeout, claim_attempts):
+        value = _run_tpu_child(dict(os.environ), tpu_timeout)
+        if value is None:
+            log("ACCELERATOR RUN FAILED — see stage logs above")
+    if value is None:
+        # loud, labelled CPU fallback: the artifact must never silently
+        # pass off a CPU number as the accelerator result
+        fallback = True
+        log("falling back to CPU (metric labelled _cpu_fallback)")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        value = _run_tpu_child(env, tpu_timeout)
+        if value is None:
+            raise SystemExit("bench failed on accelerator AND cpu")
+
     metric = (
         "awlwwmap_1m_key_64_neighbour_merges_per_sec"
         if not SMOKE
@@ -262,9 +355,9 @@ def main():
         json.dumps(
             {
                 "metric": metric,
-                "value": round(tpu, 2),
+                "value": round(value, 2),
                 "unit": "merges/sec",
-                "vs_baseline": round(tpu / py, 3),
+                "vs_baseline": round(value / py, 3),
             }
         )
     )

@@ -9,12 +9,16 @@ kernel chain.
 """
 
 from delta_crdt_ex_tpu.models.binned import BinnedStore
-from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap
-from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap as AWLWWMap
 
 __all__ = ["AWLWWMap", "BinnedAWLWWMap", "BinnedStore", "DotStore", "FlatAWLWWMap"]
 
+# All model classes resolve lazily: ``binned_map`` imports ``ops.binned``
+# which imports ``models.binned`` — an eager import here would re-enter
+# this package mid-initialisation (circular import) whenever ``ops.binned``
+# is the first module loaded.
 _LAZY = {
+    "BinnedAWLWWMap": ("delta_crdt_ex_tpu.models.binned_map", "BinnedAWLWWMap"),
+    "AWLWWMap": ("delta_crdt_ex_tpu.models.binned_map", "BinnedAWLWWMap"),
     "FlatAWLWWMap": ("delta_crdt_ex_tpu.models.aw_lww_map", "AWLWWMap"),
     "DotStore": ("delta_crdt_ex_tpu.models.state", "DotStore"),
 }

@@ -6,6 +6,7 @@ from delta_crdt_ex_tpu.parallel.batched_sync import (
 )
 from delta_crdt_ex_tpu.parallel.mesh_gossip import (
     AXIS,
+    gossip_delta_step,
     gossip_train_step,
     make_mesh,
     place_states,
@@ -15,6 +16,7 @@ from delta_crdt_ex_tpu.parallel.mesh_gossip import (
 __all__ = [
     "AXIS",
     "fanout_merge",
+    "gossip_delta_step",
     "gossip_train_step",
     "make_mesh",
     "place_states",

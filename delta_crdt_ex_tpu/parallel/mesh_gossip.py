@@ -69,6 +69,92 @@ def _unsqueeze(tree):
     return jax.tree_util.tree_map(lambda x: x[None], tree)
 
 
+@partial(jax.jit, static_argnames=("mesh", "kill_budget", "frontier"))
+def gossip_delta_step(
+    mesh: Mesh,
+    stacked: BinnedStore,
+    self_slot: jnp.ndarray,  # int32[N]
+    rows: jnp.ndarray,  # int32[N, U]  bucket-grouped mutation batches
+    op: jnp.ndarray,  # int32[N, U, M]
+    key: jnp.ndarray,  # uint64[N, U, M]
+    valh: jnp.ndarray,  # uint32[N, U, M]
+    ts: jnp.ndarray,  # int64[N, U, M]
+    kill_budget: int = 64,
+    frontier: int = 64,
+):
+    """One bounded-divergence SPMD gossip step — ICI bytes ∝ divergence.
+
+    The host sync walk ships digest blocks level-by-level because digests
+    are expensive to move over a slow control plane (the reference's
+    8-levels-per-round partial diff, ``causal_crdt.ex:96,255``). Over ICI
+    the whole leaf-digest vector is cheap (``L`` × 4 bytes ≪ one bucket
+    slice), so the walk collapses to a single exchange; the bytes that
+    matter — entry slices — ship only for buckets that actually differ:
+
+    1. apply the per-replica local mutation batch (``row_apply``);
+    2. ppermute **leaf digests** one hop forward (i → i+1): the receiver
+       compares against its own leaves and selects up to ``frontier``
+       differing buckets (fixed-size padded frontier — the
+       ``max_sync_size`` analog, ``causal_crdt.ex:206-214``);
+    3. ppermute the **frontier request** one hop backward (the receiver
+       asks its ring predecessor — the ``{:get_diff, …}`` analog,
+       ``causal_crdt.ex:112-123``);
+    4. the predecessor extracts exactly those bucket rows and ppermutes
+       the **slice** forward; the receiver joins it shard-locally.
+
+    Per-step ICI traffic = L·4 (digests) + frontier·4 (request) + one
+    fixed ``frontier``-row slice — the slice is the only term that scales,
+    and it is bounded by the actual divergence (padded rows are -1 and
+    merge as no-ops). Divergence beyond ``frontier`` buckets heals over
+    subsequent steps (``n_diff`` reports the true differing-bucket count;
+    sync is idempotent).
+
+    Returns ``(stacked, roots, ok, n_diff)``; ``ok[i]`` folds the local
+    apply's bin-capacity flag AND the merge's tier flags — a False means
+    replica i's step is invalid and the host must grow that tier and
+    replay (growth cannot happen inside the SPMD program).
+    """
+    n = mesh.devices.size
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    bwd = [(i, (i - 1) % n) for i in range(n)]
+    spec = P(AXIS)
+
+    def step(local, slot, rows_b, op_b, key_b, valh_b, ts_b):
+        local = _squeeze(local)
+        applied = row_apply(
+            local, slot[0], rows_b[0], op_b[0], key_b[0], valh_b[0], ts_b[0]
+        )
+        st = applied.state
+
+        # 2. digest exchange: predecessor's leaves arrive here
+        prev_leaf = jax.lax.ppermute(st.leaf, AXIS, fwd)
+        diff = prev_leaf != st.leaf
+        n_diff = jnp.sum(diff.astype(jnp.int32))
+        order = jnp.argsort(~diff, stable=True)[:frontier]
+        want = jnp.where(diff[order], order.astype(jnp.int32), -1)
+
+        # 3. frontier request travels backward to the predecessor
+        asked = jax.lax.ppermute(want, AXIS, bwd)
+
+        # 4. predecessor gathers its rows; slice travels forward
+        sl_local = extract_rows(st, asked)
+        sl = jax.tree_util.tree_map(
+            lambda x: jax.lax.ppermute(x, AXIS, fwd), sl_local
+        )
+        res = merge_slice(st, sl, kill_budget)
+        root = tree_from_leaves(res.state.leaf)[0][0]
+        ok = applied.ok & res.ok
+        return _unsqueeze(res.state), root[None], ok[None], n_diff[None]
+
+    return shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec, spec, spec, spec),
+        out_specs=(spec, spec, spec, spec),
+        check_vma=False,
+    )(stacked, self_slot, rows, op, key, valh, ts)
+
+
 @partial(jax.jit, static_argnames=("mesh", "kill_budget"))
 def gossip_train_step(
     mesh: Mesh,
@@ -100,15 +186,18 @@ def gossip_train_step(
         local = _squeeze(local)
         applied = row_apply(
             local, slot[0], rows_b[0], op_b[0], key_b[0], valh_b[0], ts_b[0]
-        ).state
-        received = jax.tree_util.tree_map(
-            lambda x: jax.lax.ppermute(x, AXIS, perm), applied
         )
-        all_rows = jnp.arange(applied.num_buckets, dtype=jnp.int32)
+        received = jax.tree_util.tree_map(
+            lambda x: jax.lax.ppermute(x, AXIS, perm), applied.state
+        )
+        all_rows = jnp.arange(applied.state.num_buckets, dtype=jnp.int32)
         sl = extract_rows(received, all_rows)
-        res = merge_slice(applied, sl, kill_budget)
+        res = merge_slice(applied.state, sl, kill_budget)
         root = tree_from_leaves(res.state.leaf)[0][0]
-        return _unsqueeze(res.state), root[None], res.ok[None]
+        # ok folds the mutation batch's bin-capacity flag too: a dropped
+        # insert (scatter mode='drop') must be as loud as a merge overflow
+        ok = applied.ok & res.ok
+        return _unsqueeze(res.state), root[None], ok[None]
 
     return shard_map(
         step,

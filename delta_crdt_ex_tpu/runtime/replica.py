@@ -164,7 +164,10 @@ class Replica:
     # rehydrate / persist (reference causal_crdt.ex:216-250)
 
     def _rehydrate(self, snap: Snapshot) -> None:
-        layout = getattr(snap, "layout", "<untagged>")
+        # NB: __dict__.get, not getattr — a legacy pickle missing the field
+        # would otherwise read the dataclass *default* (== CURRENT_LAYOUT)
+        # and sail past the guard into an opaque KeyError below
+        layout = snap.__dict__.get("layout", "<untagged>")
         if layout != CURRENT_LAYOUT:
             raise ValueError(
                 f"snapshot for {self.name!r} was written by engine layout "
@@ -427,12 +430,17 @@ class Replica:
                 out[kh] = (int(gid[i]), int(ctr[i]), int(valh[i]), int(ts[i]))
         return out
 
-    def _winner_records_rows(self, rows: np.ndarray | None) -> dict[int, tuple]:
-        """LWW winner records, keyed by key hash, within the given bucket
-        rows (``None`` = the whole map, chunked)."""
+    def _winner_arrays_rows(
+        self, rows: np.ndarray | None
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """LWW winner entries within the given bucket rows (``None`` = the
+        whole map, chunked) as flat numpy columns ``(key, gid, ctr, valh,
+        ts)`` — array form so the 1M-key full read never runs a per-entry
+        Python loop (each key appears once: winners are per-key unique and
+        key sets of distinct rows are disjoint)."""
         if rows is None:
             rows = np.arange(self.num_buckets, dtype=np.int32)
-        out: dict[int, tuple] = {}
+        cols: list[tuple] = []
         CHUNK = 4096
         for s in range(0, len(rows), CHUNK):
             chunk = rows[s : s + CHUNK]
@@ -441,14 +449,31 @@ class Replica:
             w = self.model.winner_rows(self.state, jnp.asarray(padded))
             win = np.asarray(w.win)
             u_idx, b_idx = np.nonzero(win)
-            key = np.asarray(w.key)[u_idx, b_idx]
-            gid = np.asarray(w.gid)[u_idx, b_idx]
-            ctr = np.asarray(w.ctr)[u_idx, b_idx]
-            valh = np.asarray(w.valh)[u_idx, b_idx]
-            ts = np.asarray(w.ts)[u_idx, b_idx]
-            for i in range(len(key)):
-                out[int(key[i])] = (int(gid[i]), int(ctr[i]), int(valh[i]), int(ts[i]))
-        return out
+            cols.append(
+                tuple(
+                    np.asarray(a)[u_idx, b_idx]
+                    for a in (w.key, w.gid, w.ctr, w.valh, w.ts)
+                )
+            )
+        if not cols:  # empty rows (e.g. an all-padding EntriesMsg)
+            return (
+                np.zeros(0, np.uint64),
+                np.zeros(0, np.uint64),
+                np.zeros(0, np.uint32),
+                np.zeros(0, np.uint32),
+                np.zeros(0, np.int64),
+            )
+        return tuple(np.concatenate(c) for c in zip(*cols))  # type: ignore[return-value]
+
+    def _winner_records_rows(self, rows: np.ndarray | None) -> dict[int, tuple]:
+        """Winner records keyed by key hash (dict form, for diff compare)."""
+        key, gid, ctr, valh, ts = self._winner_arrays_rows(rows)
+        return dict(
+            zip(
+                key.tolist(),
+                zip(gid.tolist(), ctr.tolist(), valh.tolist(), ts.tolist()),
+            )
+        )
 
     def _note_state_changed(self, count_fn: Callable[[], int]) -> None:
         """Invalidate read/tree caches and emit ``SYNC_DONE`` telemetry.
@@ -506,10 +531,12 @@ class Replica:
         return out
 
     def _read_all_items(self) -> list[tuple[Any, Any]]:
-        recs = self._winner_records_rows(None)
+        key, gid, ctr, _valh, _ts = self._winner_arrays_rows(None)
+        key_terms = self._key_terms
+        payloads = self._payloads
         return [
-            (self._key_terms[kh], self._payloads[(gid, ctr)][1])
-            for kh, (gid, ctr, _valh, _ts) in recs.items()
+            (key_terms[kh], payloads[dot][1])
+            for kh, dot in zip(key.tolist(), zip(gid.tolist(), ctr.tolist()))
         ]
 
     def read_items(self) -> list[tuple[Any, Any]]:

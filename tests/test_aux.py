@@ -126,3 +126,12 @@ def test_rehydrate_rejects_foreign_layout(transport, shared_clock):
     store.write("laytag", dataclasses.replace(snap, layout="flat-v0"))
     with pytest.raises(ValueError, match="engine layout"):
         mk(transport, shared_clock, name="laytag", storage_module=store)
+
+    # the real legacy case: a snapshot pickled BEFORE the tag existed has
+    # no 'layout' in its instance dict, and unpickling falls back to the
+    # dataclass default — the guard must read __dict__, not getattr
+    untagged = dataclasses.replace(snap)
+    del untagged.__dict__["layout"]
+    store.write("laytag", untagged)
+    with pytest.raises(ValueError, match="engine layout"):
+        mk(transport, shared_clock, name="laytag", storage_module=store)

@@ -15,6 +15,7 @@ from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap, group_batch
 from delta_crdt_ex_tpu.ops.apply import OP_ADD, OP_PAD
 from delta_crdt_ex_tpu.parallel import (
     fanout_merge,
+    gossip_delta_step,
     gossip_train_step,
     make_mesh,
     place_states,
@@ -123,5 +124,68 @@ def test_mesh_gossip_train_step_converges():
     roots = np.asarray(roots)
     assert (roots == roots[0]).all(), "digest roots must agree after full ring"
     want = {1000 + i: i for i in range(n)}
+    for st in unstack_states(stacked):
+        assert _read(st) == want
+
+
+def test_mesh_gossip_delta_step_converges():
+    """Bounded-divergence SPMD step: digest exchange -> frontier request ->
+    slice ship. Converges the ring and reports the true divergence count."""
+    n = len(jax.devices())
+    mesh = make_mesh()
+    maps = fresh_states(n, capacity=128)
+    stacked = place_states([m.state for m in maps], mesh)
+    self_slot = jnp.zeros(n, jnp.int32)
+    num_buckets = maps[0].state.num_buckets
+
+    batches = grouped_mutations(
+        n, num_buckets, [[(OP_ADD, 1000 + i, i, i + 1)] for i in range(n)]
+    )
+    stacked, roots, oks, n_diff = gossip_delta_step(
+        mesh, stacked, self_slot, *batches
+    )
+    assert bool(oks.all())
+    empty = grouped_mutations(n, num_buckets, [[] for _ in range(n)])
+    for _ in range(2 * n):
+        stacked, roots, oks, n_diff = gossip_delta_step(
+            mesh, stacked, self_slot, *empty
+        )
+        assert bool(oks.all())
+
+    roots = np.asarray(roots)
+    assert (roots == roots[0]).all(), "digest roots must agree after ring heals"
+    assert int(np.asarray(n_diff).max()) == 0, "no divergence left"
+    want = {1000 + i: i for i in range(n)}
+    for st in unstack_states(stacked):
+        assert _read(st) == want
+
+
+def test_mesh_gossip_delta_step_frontier_truncation_heals():
+    """Divergence wider than the frontier heals over multiple steps: with
+    frontier=2 a replica holding 5 distinct-bucket keys still propagates
+    them all around the ring, 2 buckets per edge per step (the
+    max_sync_size analog, causal_crdt.ex:206-214)."""
+    n = len(jax.devices())
+    mesh = make_mesh()
+    maps = fresh_states(n, capacity=128)
+    # distinct buckets: keys 0..4 land in buckets 0..4 (key & (L-1))
+    seed_keys = [3, 7, 11, 19, 23]
+    for j, k in enumerate(seed_keys):
+        maps[0].add(k, 100 + j, ts=j + 1)
+    stacked = place_states([m.state for m in maps], mesh)
+    self_slot = jnp.zeros(n, jnp.int32)
+    num_buckets = maps[0].state.num_buckets
+    empty = grouped_mutations(n, num_buckets, [[] for _ in range(n)])
+
+    diffs_seen = []
+    for _ in range(3 * (n + len(seed_keys))):
+        stacked, roots, oks, n_diff = gossip_delta_step(
+            mesh, stacked, self_slot, *empty, frontier=2
+        )
+        assert bool(oks.all())
+        diffs_seen.append(int(np.asarray(n_diff).max()))
+    assert max(diffs_seen[:1]) >= 3, "initial divergence exceeds the frontier"
+    assert diffs_seen[-1] == 0
+    want = {k: 100 + j for j, k in enumerate(seed_keys)}
     for st in unstack_states(stacked):
         assert _read(st) == want
