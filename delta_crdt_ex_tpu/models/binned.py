@@ -145,13 +145,19 @@ class BinnedStore:
         self, bin_capacity: int | None = None, replica_capacity: int | None = None
     ) -> "BinnedStore":
         """Pad to a larger tier. L never changes (it is the cluster-agreed
-        sync-index geometry); rows and context tables pad with dead slots."""
+        sync-index geometry); rows and context tables pad with dead slots.
+        Rank-agnostic: works on a single state ([L, B] columns) and on a
+        neighbour-stacked state ([N, L, B]) alike — padding is always on
+        the last axis."""
         b_new = bin_capacity or self.bin_capacity
         r_new = replica_capacity or self.replica_capacity
         db = b_new - self.bin_capacity
         dr = r_new - self.replica_capacity
         assert db >= 0 and dr >= 0
-        padb = lambda a: jnp.pad(a, ((0, 0), (0, db))) if db else a
+        last = lambda a, d, **kw: jnp.pad(
+            a, ((0, 0),) * (a.ndim - 1) + ((0, d),), **kw
+        )
+        padb = lambda a: last(a, db) if db else a
         return BinnedStore(
             key=padb(self.key),
             valh=padb(self.valh),
@@ -161,13 +167,11 @@ class BinnedStore:
             alive=padb(self.alive),
             ehash=padb(self.ehash),
             fill=self.fill,
-            amin=jnp.pad(self.amin, ((0, 0), (0, dr)), constant_values=U32_MAX)
-            if dr
-            else self.amin,
-            amax=jnp.pad(self.amax, ((0, 0), (0, dr))) if dr else self.amax,
+            amin=last(self.amin, dr, constant_values=U32_MAX) if dr else self.amin,
+            amax=last(self.amax, dr) if dr else self.amax,
             leaf=self.leaf,
-            ctx_gid=jnp.pad(self.ctx_gid, (0, dr)) if dr else self.ctx_gid,
-            ctx_max=jnp.pad(self.ctx_max, ((0, 0), (0, dr))) if dr else self.ctx_max,
+            ctx_gid=last(self.ctx_gid, dr) if dr else self.ctx_gid,
+            ctx_max=last(self.ctx_max, dr) if dr else self.ctx_max,
         )
 
     def entry_gid(self) -> jax.Array:

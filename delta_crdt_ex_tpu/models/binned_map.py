@@ -100,52 +100,89 @@ class CtxGapError(ValueError):
     state-form slices, so no catcher exists there yet.)"""
 
 
+def tier_retry_merge(
+    state: BinnedStore,
+    sl,
+    merge,
+    compact,
+    kill_budget: int,
+    max_inserts: int,
+    on_grow=None,
+):
+    """The tier-escalation policy shared by the single-state and the
+    vmapped fan-out merge paths: run ``merge(state, sl, kill_budget,
+    max_inserts)``, and on overflow grow the offending tier and retry —
+    gid table ×2, kill budget ×4 (capped at the slice's row count),
+    insert tier ×4 (capped at the slice grid), and for fill overflow one
+    compact first, then bin capacity ×2. ``merge`` may be vmapped: flags
+    are reduced with any()/all(), so one overflowing neighbour retiers
+    the whole stack.
+
+    Returns ``(new_state, last_result, n_retries)``; each retry is one
+    fresh jit compile of the new tier combination. Worst case retries ≤
+    ``log4(U/kb0) + 1 + log2(B_end/B0) + log2(R_end/R0)`` (holes cannot
+    reappear between a compact and the next retry — only successful
+    merges create them — so after one compact further fill overflows go
+    straight to bin growth).
+
+    Raises :class:`CtxGapError` on a non-contiguous delta-interval:
+    growth cannot heal that — the *sender* must fall back to a full-row
+    (state-form, ``ctx_lo=0``) slice.
+    """
+    compacted = False
+    retries = 0
+    mi = max_inserts
+    while True:
+        res = merge(state, sl, kill_budget, mi)
+        if bool(np.asarray(res.ok).all()):
+            return res.state, res, retries
+        retries += 1
+        if bool(np.asarray(res.need_ctx_gap).any()):
+            raise CtxGapError(
+                "delta-interval slice is not contiguous with the local "
+                "context; re-sync with a full-row slice (ctx_lo=0)"
+            )
+        if bool(np.asarray(res.need_gid_grow).any()):
+            state = state.grow(replica_capacity=state.replica_capacity * 2)
+            if on_grow:
+                on_grow(state)
+        if bool(np.asarray(res.need_kill_tier).any()):
+            kill_budget = min(kill_budget * 4, int(sl.rows.shape[0]))
+        if bool(np.asarray(res.need_ins_tier).any()):
+            mi = min(mi * 4, int(sl.alive.size))
+        if bool(np.asarray(res.need_fill_compact).any()):
+            if not compacted:
+                state = compact(state)
+                compacted = True
+            else:
+                state = state.grow(bin_capacity=state.bin_capacity * 2)
+                if on_grow:
+                    on_grow(state)
+
+
 def merge_into(
     state: BinnedStore, sl, kill_budget: int = 16, on_grow=None, n_alive: int | None = None
 ):
     """Merge a :class:`~delta_crdt_ex_tpu.ops.binned.RowSlice` into
-    ``state``, handling every ``need_*`` escape hatch: grow the gid table,
-    raise the kill-budget tier, compact holes, grow the bin tier. Returns
-    ``(new_state, last_result)``. ``on_grow(state)`` fires after each
-    capacity growth (telemetry hook).
-
-    Holes cannot reappear between a compact and the next retry (only
-    successful merges create them), so after one compact further fill
-    overflows go straight to bin growth.
-    """
+    ``state`` via :func:`tier_retry_merge`. Returns ``(new_state,
+    last_result)``. ``on_grow(state)`` fires after each capacity growth
+    (telemetry hook)."""
     # compact the insert scatter to a power-of-two tier of the slice's
     # alive count (scatter cost is per index entry; the [U, S] grid is
     # mostly padding); callers that built the slice from host arrays pass
     # n_alive to avoid a device->host readback here
     if n_alive is None:
         n_alive = int(np.asarray(sl.alive).sum())
-    mi = _pow2(max(n_alive, 1))
-    compacted = False
-    while True:
-        res = jit_merge_slice(state, sl, kill_budget=kill_budget, max_inserts=mi)
-        if bool(res.ok):
-            return res.state, res
-        if bool(res.need_ctx_gap):
-            raise CtxGapError(
-                "delta-interval slice is not contiguous with the local "
-                "context; re-sync with a full-row slice (ctx_lo=0)"
-            )
-        if bool(res.need_gid_grow):
-            state = state.grow(replica_capacity=state.replica_capacity * 2)
-            if on_grow:
-                on_grow(state)
-        if bool(res.need_kill_tier):
-            kill_budget = min(kill_budget * 4, int(sl.rows.shape[0]))
-        if bool(res.need_ins_tier):
-            mi = min(mi * 4, int(sl.alive.size))
-        if bool(res.need_fill_compact):
-            if not compacted:
-                state = jit_compact_rows(state)
-                compacted = True
-            else:
-                state = state.grow(bin_capacity=state.bin_capacity * 2)
-                if on_grow:
-                    on_grow(state)
+    new_state, res, _ = tier_retry_merge(
+        state,
+        sl,
+        lambda st, s, kb, mi: jit_merge_slice(st, s, kill_budget=kb, max_inserts=mi),
+        jit_compact_rows,
+        kill_budget,
+        _pow2(max(n_alive, 1)),
+        on_grow=on_grow,
+    )
+    return new_state, res
 
 
 class BinnedAWLWWMap:

@@ -1,5 +1,6 @@
 from delta_crdt_ex_tpu.parallel.batched_sync import (
     fanout_merge,
+    fanout_merge_into,
     ring_gossip_round,
     stack_states,
     unstack_states,
@@ -16,6 +17,7 @@ from delta_crdt_ex_tpu.parallel.mesh_gossip import (
 __all__ = [
     "AXIS",
     "fanout_merge",
+    "fanout_merge_into",
     "gossip_delta_step",
     "gossip_train_step",
     "make_mesh",

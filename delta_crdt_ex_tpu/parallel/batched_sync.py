@@ -23,6 +23,7 @@ from delta_crdt_ex_tpu.models.binned import BinnedStore
 from delta_crdt_ex_tpu.ops.binned import (
     MergeResult,
     RowSlice,
+    compact_rows,
     extract_rows,
     merge_slice,
 )
@@ -54,6 +55,42 @@ def fanout_merge(
     """
     return jax.vmap(merge_slice, in_axes=(0, None, None, None))(
         stacked, sl, kill_budget, max_inserts
+    )
+
+
+jit_fanout_compact = jax.jit(jax.vmap(compact_rows))
+
+
+def fanout_merge_into(
+    stacked: BinnedStore,
+    sl: RowSlice,
+    kill_budget: int = 16,
+    on_grow=None,
+    n_alive: int | None = None,
+):
+    """The vmapped analog of ``merge_into``: merge one slice into N
+    stacked neighbour states, escalating tiers via the shared
+    :func:`~delta_crdt_ex_tpu.models.binned_map.tier_retry_merge` policy.
+    Tiers are uniform across the stack (a grow applies to all N states),
+    so a single overflowing neighbour retiers everyone — the price of
+    the one-call fan-out; each retier is one fresh jit compile.
+
+    Returns ``(stacked, last_result, n_retries)``."""
+    import numpy as np
+
+    from delta_crdt_ex_tpu.models.binned import pow2_tier
+    from delta_crdt_ex_tpu.models.binned_map import tier_retry_merge
+
+    if n_alive is None:
+        n_alive = int(np.asarray(sl.alive).sum())
+    return tier_retry_merge(
+        stacked,
+        sl,
+        fanout_merge,
+        jit_fanout_compact,
+        kill_budget,
+        pow2_tier(max(n_alive, 1)),
+        on_grow=on_grow,
     )
 
 

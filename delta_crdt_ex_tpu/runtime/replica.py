@@ -208,10 +208,24 @@ class Replica:
     # ------------------------------------------------------------------
     # public API (facade parity: delta_crdt.ex:97-137)
 
+    def _acquire(self, timeout: float | None, what: str) -> None:
+        """GenServer.call timeout semantics (``delta_crdt.ex:117-137``):
+        the call blocks on the replica's serialisation lock for at most
+        ``timeout`` seconds, then raises. The timeout bounds *queueing*
+        (a busy sync thread); once acquired, the operation runs to
+        completion like a received GenServer call."""
+        if not self._lock.acquire(timeout=-1 if timeout is None else timeout):
+            raise TimeoutError(
+                f"{what} timed out after {timeout}s waiting for replica {self.name!r}"
+            )
+
     def mutate(self, f: str, args: list, timeout: float | None = None) -> None:
-        with self._lock:
+        self._acquire(timeout, f"mutate {f!r}")
+        try:
             self._enqueue(f, args)
             self._flush()
+        finally:
+            self._lock.release()
 
     def mutate_async(self, f: str, args: list) -> None:
         with self._lock:
@@ -238,11 +252,14 @@ class Replica:
             self._flush()
 
     def read(self, timeout: float | None = None) -> dict:
-        with self._lock:
+        self._acquire(timeout, "read")
+        try:
             self._flush()
             if self._read_cache is None:
                 self._read_cache = self._read_all()
             return dict(self._read_cache)
+        finally:
+            self._lock.release()
 
     def read_keys(self, key_terms: list) -> dict:
         """Partial read (reference ``AWLWWMap.read/2``, ``aw_lww_map.ex:218-224``)."""

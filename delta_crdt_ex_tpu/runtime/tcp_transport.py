@@ -48,6 +48,59 @@ def _send_frame(sock: socket.socket, kind: int, payload: bytes) -> None:
     sock.sendall(_LEN.pack(len(payload) + 1) + bytes([kind]) + payload)
 
 
+class _SenderConn:
+    """One pooled outbound connection with its own send queue + thread.
+
+    ``sendall`` to a stalled peer can block for the full socket timeout;
+    pushing it onto a per-connection thread means one slow peer delays
+    only its own queue — every other edge keeps flowing (the failure-
+    isolation the reference gets from per-process mailboxes). A full
+    queue drops the frame (anti-entropy is idempotent and retried, so
+    backpressure loss only delays convergence)."""
+
+    QUEUE_MAX = 256
+
+    def __init__(self, sock: socket.socket, on_dead) -> None:
+        self.sock = sock
+        self._q: queue.Queue = queue.Queue(maxsize=self.QUEUE_MAX)
+        self._on_dead = on_dead
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def enqueue(self, kind: int, payload: bytes) -> bool:
+        try:
+            self._q.put_nowait((kind, payload))
+            return True
+        except queue.Full:
+            return False  # dropped; periodic sync will retry
+
+    def close(self) -> None:
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            kind, payload = item
+            try:
+                _send_frame(self.sock, kind, payload)
+            except OSError:
+                self._on_dead(self)
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                return
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
     buf = b""
     while len(buf) < n:
@@ -70,7 +123,8 @@ class TcpTransport:
         self._mailboxes: dict[Hashable, queue.Queue] = {}
         self._owners: dict[Hashable, Any] = {}
         self._monitors: dict[Hashable, set[Hashable]] = {}
-        self._conns: dict[tuple, socket.socket] = {}
+        self._conns: dict[tuple, _SenderConn] = {}
+        self._hb_conns: dict[tuple, socket.socket] = {}  # persistent ping conns
         self.heartbeat_interval = heartbeat_interval
         self._stop = threading.Event()
 
@@ -156,43 +210,47 @@ class TcpTransport:
             notify()
         return True
 
-    def _connect(self, endpoint: tuple) -> socket.socket | None:
+    def _connect(self, endpoint: tuple) -> "_SenderConn | None":
         with self._lock:
-            sock = self._conns.get(endpoint)
-        if sock is not None:
-            return sock
+            conn = self._conns.get(endpoint)
+        if conn is not None:
+            return conn
         try:
             sock = socket.create_connection(endpoint, timeout=2.0)
             sock.settimeout(5.0)
         except OSError:
             return None
+
+        def on_dead(dead_conn):
+            with self._lock:
+                if self._conns.get(endpoint) is dead_conn:
+                    del self._conns[endpoint]
+
+        conn = _SenderConn(sock, on_dead)
         with self._lock:
-            self._conns[endpoint] = sock
-        return sock
+            existing = self._conns.get(endpoint)
+            if existing is not None:
+                conn.close()
+                return existing
+            self._conns[endpoint] = conn
+        return conn
 
     def _drop_conn(self, endpoint: tuple) -> None:
         with self._lock:
-            sock = self._conns.pop(endpoint, None)
-        if sock is not None:
-            try:
-                sock.close()
-            except OSError:
-                pass
+            conn = self._conns.pop(endpoint, None)
+        if conn is not None:
+            conn.close()
 
     def _send_remote(self, addr: tuple, frame: tuple) -> bool:
+        """Fast-fail if no connection can be established (the dead-
+        neighbour signal, ``causal_crdt.ex:269-282``); otherwise enqueue
+        on the connection's sender thread and return immediately."""
         _name, endpoint = addr
         payload = pickle.dumps(frame[1:], protocol=4)
-        for _attempt in range(2):  # one reconnect on a stale pooled conn
-            sock = self._connect(endpoint)
-            if sock is None:
-                return False
-            try:
-                with self._lock:
-                    _send_frame(sock, frame[0], payload)
-                return True
-            except OSError:
-                self._drop_conn(endpoint)
-        return False
+        conn = self._connect(endpoint)
+        if conn is None:
+            return False
+        return conn.enqueue(frame[0], payload)
 
     def _ping(self, addr: tuple) -> bool:
         # connection-level liveness: a fresh short-lived connection probes
@@ -226,16 +284,65 @@ class TcpTransport:
         with self._lock:
             self._monitors.get(target, set()).discard(watcher)
 
+    def _hb_drop(self, endpoint: tuple) -> None:
+        sock = self._hb_conns.pop(endpoint, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _ping_once(self, endpoint: tuple) -> bool:
+        """One ping round-trip over the cached per-endpoint connection
+        (opened on first use) — no connection churn per tick."""
+        sock = self._hb_conns.get(endpoint)
+        try:
+            if sock is None:
+                sock = socket.create_connection(endpoint, timeout=1.0)
+                sock.settimeout(2.0)
+                self._hb_conns[endpoint] = sock
+            _send_frame(sock, _PING, b"")
+            hdr = _recv_exact(sock, 4)
+            if hdr is None:
+                raise OSError("peer closed")
+            body = _recv_exact(sock, _LEN.unpack(hdr)[0])
+            if body is None or body[0] != _PONG:
+                raise OSError("bad pong")
+            return True
+        except OSError:
+            self._hb_drop(endpoint)
+            return False
+
+    def _ping_persistent(self, endpoint: tuple) -> bool:
+        """Heartbeat with a PERSISTENT connection, but never declare a
+        peer dead on a stale cached socket alone: a failed cached ping
+        retries once on a fresh connection (a transport restart on the
+        same port, or an idle conn reset, must not deliver a false Down).
+        Only the heartbeat thread touches ``_hb_conns``."""
+        had_conn = self._hb_conns.get(endpoint) is not None
+        if self._ping_once(endpoint):
+            return True
+        return had_conn and self._ping_once(endpoint)
+
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_interval):
             with self._lock:
                 remote_targets = [t for t in self._monitors if self._is_remote(t)]
+            # close heartbeat conns for endpoints no longer monitored
+            # (demonitored peers must not leak sockets)
+            live = {t[1] for t in remote_targets}
+            for ep in [e for e in self._hb_conns if e not in live]:
+                self._hb_drop(ep)
             for t in remote_targets:
-                if not self._ping(t):
+                if not self._ping_persistent(t[1]):
                     with self._lock:
                         watchers = self._monitors.pop(t, set())
                     for w in watchers:
                         self.send(w, Down(t))
+        # _stop is set: release remaining heartbeat conns on this thread
+        # (the only writer of _hb_conns — close() joins us, no race)
+        for ep in list(self._hb_conns):
+            self._hb_drop(ep)
 
     # -- receiving ---------------------------------------------------------
 
@@ -308,11 +415,11 @@ class TcpTransport:
             self._listener.close()
         except OSError:
             pass
+        # heartbeat conns are owned by the hb thread; joining it (it exits
+        # promptly on _stop) lets it close them without a cross-thread race
+        self._hb_thread.join(timeout=5)
         with self._lock:
             conns = list(self._conns.values())
             self._conns.clear()
         for c in conns:
-            try:
-                c.close()
-            except OSError:
-                pass
+            c.close()

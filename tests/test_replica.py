@@ -272,3 +272,36 @@ def test_subscriberless_sync_skips_winner_passes(transport, shared_clock, monkey
     )
     monkeypatch.undo()
     assert c2.read() == {"Derek": "Kraan"}
+
+
+def test_mutate_and_read_honor_call_timeouts(transport, shared_clock):
+    """GenServer.call timeout parity (delta_crdt.ex:117-137): a busy
+    replica raises TimeoutError instead of blocking forever."""
+    import threading
+    import time as _time
+
+    c = mk(transport, shared_clock)
+    c.mutate("add", ["k", 1])  # warm the compile so timings are honest
+
+    hold = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with c._lock:
+            hold.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    assert hold.wait(5)
+    t0 = _time.monotonic()
+    with pytest.raises(TimeoutError, match="mutate"):
+        c.mutate("add", ["k2", 2], timeout=0.2)
+    with pytest.raises(TimeoutError, match="read"):
+        c.read(timeout=0.2)
+    assert _time.monotonic() - t0 < 2.0
+    release.set()
+    t.join()
+    # after the lock frees, the same calls succeed
+    c.mutate("add", ["k2", 2], timeout=5)
+    assert c.read(timeout=5)["k2"] == 2

@@ -63,6 +63,44 @@ def test_remote_liveness_ping(two_nodes):
     assert not ta.alive(("anything", tb.endpoint))
 
 
+def test_stalled_peer_does_not_block_other_edges(two_nodes):
+    """One peer that accepts but never reads must not stall sends to
+    anyone else: sendall runs on a per-connection sender thread, so the
+    caller returns immediately and the healthy edge keeps flowing
+    (failure isolation of the reference's per-process mailboxes)."""
+    import socket as socketlib
+
+    import numpy as np
+
+    ta, tb = two_nodes
+
+    srv = socketlib.socket(socketlib.AF_INET, socketlib.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    stalled_ep = srv.getsockname()
+    try:
+        # flood the stalled edge with frames far beyond any socket buffer
+        big = np.zeros(4_000_000, np.uint8)
+        t0 = time.monotonic()
+        for _ in range(8):
+            assert ta.send(("x", stalled_ep), big)
+        assert time.monotonic() - t0 < 2.0, "send() blocked on a stalled socket"
+
+        class Sink:
+            pass
+
+        tb.register("sink", Sink())
+        assert ta.send(("sink", tb.endpoint), {"hello": 1})
+        deadline = time.monotonic() + 5
+        got = []
+        while time.monotonic() < deadline and not got:
+            got = tb.drain("sink")
+            time.sleep(0.01)
+        assert got == [{"hello": 1}], "healthy edge stalled behind the wedged peer"
+    finally:
+        srv.close()
+
+
 def test_down_delivered_for_dead_remote_node(two_nodes, shared_clock):
     ta, tb = two_nodes
     ta.heartbeat_interval = 0.05
