@@ -65,4 +65,25 @@ class BenchRecorder:
 
 
 def emit(name: str, results: dict):
-    log(json.dumps({"bench": name, **results}, default=float))
+    """Log results AND persist them to ``benchmarks/results/<name>.<backend>.json``
+    so measured numbers are committed alongside the harness (BASELINE.md's
+    measurement matrix)."""
+    import datetime
+    import os
+
+    import jax
+
+    backend = jax.default_backend()
+    payload = {
+        "bench": name,
+        "backend": backend,
+        "devices": [str(d) for d in jax.devices()],
+        "utc": datetime.datetime.now(datetime.timezone.utc).isoformat(timespec="seconds"),
+        **results,
+    }
+    log(json.dumps(payload, default=float))
+    out_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, f"{name}.{backend}.json"), "w") as f:
+        json.dump(payload, f, indent=1, default=float)
+        f.write("\n")

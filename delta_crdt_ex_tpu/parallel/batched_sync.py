@@ -18,8 +18,10 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from delta_crdt_ex_tpu.models.binned import BinnedStore
+from delta_crdt_ex_tpu.models.binned import BinnedStore, pow2_tier
+from delta_crdt_ex_tpu.models.binned_map import tier_retry_merge
 from delta_crdt_ex_tpu.ops.binned import (
     MergeResult,
     RowSlice,
@@ -76,11 +78,6 @@ def fanout_merge_into(
     the one-call fan-out; each retier is one fresh jit compile.
 
     Returns ``(stacked, last_result, n_retries)``."""
-    import numpy as np
-
-    from delta_crdt_ex_tpu.models.binned import pow2_tier
-    from delta_crdt_ex_tpu.models.binned_map import tier_retry_merge
-
     if n_alive is None:
         n_alive = int(np.asarray(sl.alive).sum())
     return tier_retry_merge(

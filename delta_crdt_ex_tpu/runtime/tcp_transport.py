@@ -67,9 +67,9 @@ class _SenderConn:
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
-    def enqueue(self, kind: int, payload: bytes) -> bool:
+    def enqueue(self, kind: int, payload: bytes, attempt: int = 0) -> bool:
         try:
-            self._q.put_nowait((kind, payload))
+            self._q.put_nowait((kind, payload, attempt))
             return True
         except queue.Full:
             return False  # dropped; periodic sync will retry
@@ -89,15 +89,25 @@ class _SenderConn:
             item = self._q.get()
             if item is None:
                 return
-            kind, payload = item
             try:
-                _send_frame(self.sock, kind, payload)
+                _send_frame(self.sock, item[0], item[1])
             except OSError:
-                self._on_dead(self)
+                # hand the failed frame and the rest of the queue back to
+                # the transport: a stale pooled conn (peer restarted) must
+                # not silently eat frames the caller was told were sent
+                pending = [item]
+                while True:
+                    try:
+                        nxt = self._q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is not None:
+                        pending.append(nxt)
                 try:
                     self.sock.close()
                 except OSError:
                     pass
+                self._on_dead(self, pending)
                 return
 
 
@@ -221,10 +231,20 @@ class TcpTransport:
         except OSError:
             return None
 
-        def on_dead(dead_conn):
+        def on_dead(dead_conn, pending):
             with self._lock:
                 if self._conns.get(endpoint) is dead_conn:
                     del self._conns[endpoint]
+            # salvage frames that died on a stale pooled connection: one
+            # reconnect attempt per frame (attempt tag prevents a retry
+            # loop against a flapping peer; a dead listener fails the
+            # connect and the frames drop — the periodic sync re-covers)
+            retry = [(k, p) for k, p, attempt in pending if attempt == 0]
+            if retry and not self._stop.is_set():
+                fresh = self._connect(endpoint)
+                if fresh is not None:
+                    for k, p in retry:
+                        fresh.enqueue(k, p, attempt=1)
 
         conn = _SenderConn(sock, on_dead)
         with self._lock:
@@ -234,12 +254,6 @@ class TcpTransport:
                 return existing
             self._conns[endpoint] = conn
         return conn
-
-    def _drop_conn(self, endpoint: tuple) -> None:
-        with self._lock:
-            conn = self._conns.pop(endpoint, None)
-        if conn is not None:
-            conn.close()
 
     def _send_remote(self, addr: tuple, frame: tuple) -> bool:
         """Fast-fail if no connection can be established (the dead-
@@ -252,6 +266,17 @@ class TcpTransport:
             return False
         return conn.enqueue(frame[0], payload)
 
+    @staticmethod
+    def _ping_roundtrip(sock: socket.socket) -> bool:
+        """One PING → PONG exchange on an open socket (the single wire
+        handshake shared by ``alive()`` probes and heartbeats)."""
+        _send_frame(sock, _PING, b"")
+        hdr = _recv_exact(sock, 4)
+        if hdr is None:
+            return False
+        body = _recv_exact(sock, _LEN.unpack(hdr)[0])
+        return body is not None and body[0] == _PONG
+
     def _ping(self, addr: tuple) -> bool:
         # connection-level liveness: a fresh short-lived connection probes
         # the remote listener (the monitored name is checked by heartbeat
@@ -259,14 +284,8 @@ class TcpTransport:
         # "node down" analog)
         try:
             with socket.create_connection(addr[1], timeout=1.0) as s:
-                _send_frame(s, _PING, b"")
                 s.settimeout(2.0)
-                hdr = _recv_exact(s, 4)
-                if hdr is None:
-                    return False
-                n = _LEN.unpack(hdr)[0]
-                body = _recv_exact(s, n)
-                return body is not None and body[0] == _PONG
+                return self._ping_roundtrip(s)
         except OSError:
             return False
 
@@ -301,12 +320,7 @@ class TcpTransport:
                 sock = socket.create_connection(endpoint, timeout=1.0)
                 sock.settimeout(2.0)
                 self._hb_conns[endpoint] = sock
-            _send_frame(sock, _PING, b"")
-            hdr = _recv_exact(sock, 4)
-            if hdr is None:
-                raise OSError("peer closed")
-            body = _recv_exact(sock, _LEN.unpack(hdr)[0])
-            if body is None or body[0] != _PONG:
+            if not self._ping_roundtrip(sock):
                 raise OSError("bad pong")
             return True
         except OSError:
