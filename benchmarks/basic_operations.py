@@ -71,26 +71,24 @@ def main():
         # read-at-scale: full LWW read of a 1M-key map (VERDICT r1 #6 —
         # the reference's read is a full-map Enum.max_by pass,
         # aw_lww_map.ex:211-216; target: single-digit seconds)
-        import time as _t
-
         crdt = start_link(AWLWWMap, threaded=False, capacity=2_000_000, tree_depth=14)
-        t0 = _t.perf_counter()
+        t0 = time.perf_counter()
         for x in range(1_000_000):
             crdt.mutate_async("add", [x, x])
         crdt.flush()
-        dt = _t.perf_counter() - t0
+        dt = time.perf_counter() - t0
         results["bulk_load_1m_ops_per_sec"] = round(1_000_000 / dt, 1)
         log(f"bulk load 1M keys: {1_000_000/dt:.0f} ops/sec ({dt:.1f}s)")
-        t0 = _t.perf_counter()
+        t0 = time.perf_counter()
         m = crdt.read()
-        dt = _t.perf_counter() - t0
+        dt = time.perf_counter() - t0
         assert len(m) == 1_000_000 and m[123456] == 123456
         results["read_1m_s"] = round(dt, 2)
         log(f"full read of 1M-key map: {dt:.2f}s")
         crdt.read_keys(list(range(100, 1100)))  # warm the partial-read compile
-        t0 = _t.perf_counter()
+        t0 = time.perf_counter()
         part = crdt.read_keys(list(range(5000, 6000)))
-        dt = _t.perf_counter() - t0
+        dt = time.perf_counter() - t0
         assert len(part) == 1000
         results["read_keys_1k_of_1m_ms"] = round(dt * 1e3, 2)
         log(f"partial read (1k of 1M): {dt*1e3:.1f} ms")

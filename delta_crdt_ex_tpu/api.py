@@ -82,7 +82,17 @@ def set_neighbours(crdt: Replica, neighbours: list) -> None:
     crdt.set_neighbours(neighbours)
 
 
-def mutate(crdt: Replica, f: str, args: list, timeout: float = 5.0) -> None:
+#: default call timeout. The reference's GenServer.call default is 5s
+#: (``delta_crdt.ex:117-137``) — enough on the BEAM where every op is
+#: sub-millisecond, but here a replica's FIRST sync merge jit-compiles
+#: for seconds while holding the serialisation lock, so a 5s default
+#: would flake on cold starts. Pass ``timeout=5.0`` for strict parity.
+DEFAULT_TIMEOUT = 30.0
+
+
+def mutate(crdt: Replica, f: str, args: list, timeout: float = DEFAULT_TIMEOUT) -> None:
+    """Synchronous mutation; raises TimeoutError if the replica stays
+    busy past ``timeout`` (``DeltaCrdt.mutate/4``)."""
     crdt.mutate(f, args, timeout)
 
 
@@ -90,5 +100,5 @@ def mutate_async(crdt: Replica, f: str, args: list) -> None:
     crdt.mutate_async(f, args)
 
 
-def read(crdt: Replica, timeout: float = 5.0) -> dict[Any, Any]:
+def read(crdt: Replica, timeout: float = DEFAULT_TIMEOUT) -> dict[Any, Any]:
     return crdt.read(timeout)

@@ -7,6 +7,7 @@ from delta_crdt_ex_tpu.parallel.batched_sync import (
 )
 from delta_crdt_ex_tpu.parallel.mesh_gossip import (
     AXIS,
+    gossip_delta_drive,
     gossip_delta_step,
     gossip_train_step,
     make_mesh,
@@ -18,6 +19,7 @@ __all__ = [
     "AXIS",
     "fanout_merge",
     "fanout_merge_into",
+    "gossip_delta_drive",
     "gossip_delta_step",
     "gossip_train_step",
     "make_mesh",

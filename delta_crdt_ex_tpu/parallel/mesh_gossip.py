@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from delta_crdt_ex_tpu.models.binned import BinnedStore
 from delta_crdt_ex_tpu.ops.binned import (
+    compact_rows,
     extract_rows,
     merge_slice,
     row_apply,
@@ -109,10 +110,13 @@ def gossip_delta_step(
     subsequent steps (``n_diff`` reports the true differing-bucket count;
     sync is idempotent).
 
-    Returns ``(stacked, roots, ok, n_diff)``; ``ok[i]`` folds the local
-    apply's bin-capacity flag AND the merge's tier flags — a False means
-    replica i's step is invalid and the host must grow that tier and
-    replay (growth cannot happen inside the SPMD program).
+    Returns ``(stacked, roots, ok, n_diff, flags)``; ``ok[i]`` folds the
+    local apply's bin-capacity flag AND the merge's tier flags — a False
+    means replica i's step is invalid and the host must grow that tier
+    and replay from the pre-step state (growth cannot happen inside the
+    SPMD program; :func:`gossip_delta_drive` is that recovery loop).
+    ``flags[i] = [apply_fill, gid_grow, kill_tier, merge_fill]`` names
+    the offending tier.
     """
     n = mesh.devices.size
     fwd = [(i, (i + 1) % n) for i in range(n)]
@@ -144,15 +148,75 @@ def gossip_delta_step(
         res = merge_slice(st, sl, kill_budget)
         root = tree_from_leaves(res.state.leaf)[0][0]
         ok = applied.ok & res.ok
-        return _unsqueeze(res.state), root[None], ok[None], n_diff[None]
+        flags = jnp.stack(
+            [~applied.ok, res.need_gid_grow, res.need_kill_tier,
+             res.need_fill_compact]
+        )
+        return _unsqueeze(res.state), root[None], ok[None], n_diff[None], flags[None]
 
     return shard_map(
         step,
         mesh=mesh,
         in_specs=(spec, spec, spec, spec, spec, spec, spec),
-        out_specs=(spec, spec, spec, spec),
+        out_specs=(spec, spec, spec, spec, spec),
         check_vma=False,
     )(stacked, self_slot, rows, op, key, valh, ts)
+
+
+jit_mesh_compact = jax.jit(jax.vmap(compact_rows))
+
+
+def gossip_delta_drive(
+    mesh: Mesh,
+    stacked: BinnedStore,
+    self_slot: jnp.ndarray,
+    rows: jnp.ndarray,
+    op: jnp.ndarray,
+    key: jnp.ndarray,
+    valh: jnp.ndarray,
+    ts: jnp.ndarray,
+    kill_budget: int = 64,
+    frontier: int = 64,
+    on_grow=None,
+):
+    """Host recovery loop around :func:`gossip_delta_step`: a failed step
+    (any ``ok=False``) discards that step's states, grows the offending
+    tier on the PRE-step states, and replays — mutation batches re-apply
+    idempotently because the failed result was never kept. Tier policy
+    matches :func:`~delta_crdt_ex_tpu.models.binned_map.tier_retry_merge`
+    (bin ×2 after one compact, gid ×2, kill budget ×4 up to L); each
+    retier recompiles the step for the new shapes.
+
+    Returns ``(stacked, roots, n_diff, n_retiers)``.
+    """
+    import numpy as np
+
+    compacted = False
+    retiers = 0
+    while True:
+        out, roots, oks, n_diff, flags = gossip_delta_step(
+            mesh, stacked, self_slot, rows, op, key, valh, ts,
+            kill_budget=kill_budget, frontier=frontier,
+        )
+        if bool(np.asarray(oks).all()):
+            return out, roots, n_diff, retiers
+        retiers += 1
+        f = np.asarray(flags).any(axis=0)  # [4] any replica
+        apply_fill, gid_grow, kill_tier, merge_fill = map(bool, f)
+        if gid_grow:
+            stacked = stacked.grow(replica_capacity=stacked.replica_capacity * 2)
+            if on_grow:
+                on_grow(stacked)
+        if kill_tier:
+            kill_budget = min(kill_budget * 4, stacked.num_buckets)
+        if apply_fill or merge_fill:
+            if not compacted:
+                stacked = jit_mesh_compact(stacked)
+                compacted = True
+            else:
+                stacked = stacked.grow(bin_capacity=stacked.bin_capacity * 2)
+                if on_grow:
+                    on_grow(stacked)
 
 
 @partial(jax.jit, static_argnames=("mesh", "kill_budget"))

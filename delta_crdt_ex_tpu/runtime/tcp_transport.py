@@ -64,15 +64,23 @@ class _SenderConn:
         self.sock = sock
         self._q: queue.Queue = queue.Queue(maxsize=self.QUEUE_MAX)
         self._on_dead = on_dead
+        self._dead = False
+        self._dead_lock = threading.Lock()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     def enqueue(self, kind: int, payload: bytes, attempt: int = 0) -> bool:
-        try:
-            self._q.put_nowait((kind, payload, attempt))
-            return True
-        except queue.Full:
-            return False  # dropped; periodic sync will retry
+        # the dead flag is flipped (under this lock) BEFORE the dying
+        # sender thread drains the queue, so a late enqueue can never
+        # slip in after the drain and be claimed sent but never salvaged
+        with self._dead_lock:
+            if self._dead:
+                return False
+            try:
+                self._q.put_nowait((kind, payload, attempt))
+                return True
+            except queue.Full:
+                return False  # dropped; periodic sync will retry
 
     def close(self) -> None:
         try:
@@ -95,6 +103,8 @@ class _SenderConn:
                 # hand the failed frame and the rest of the queue back to
                 # the transport: a stale pooled conn (peer restarted) must
                 # not silently eat frames the caller was told were sent
+                with self._dead_lock:
+                    self._dead = True  # late enqueues now refuse
                 pending = [item]
                 while True:
                     try:
@@ -225,6 +235,8 @@ class TcpTransport:
             conn = self._conns.get(endpoint)
         if conn is not None:
             return conn
+        if self._stop.is_set():
+            return None
         try:
             sock = socket.create_connection(endpoint, timeout=2.0)
             sock.settimeout(5.0)
@@ -248,6 +260,11 @@ class TcpTransport:
 
         conn = _SenderConn(sock, on_dead)
         with self._lock:
+            if self._stop.is_set():
+                # close() already ran (or is running): never insert a
+                # fresh conn it would miss — refuse under the same lock
+                conn.close()
+                return None
             existing = self._conns.get(endpoint)
             if existing is not None:
                 conn.close()

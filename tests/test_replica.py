@@ -305,3 +305,56 @@ def test_mutate_and_read_honor_call_timeouts(transport, shared_clock):
     # after the lock frees, the same calls succeed
     c.mutate("add", ["k2", 2], timeout=5)
     assert c.read(timeout=5)["k2"] == 2
+
+
+def test_concurrent_mutators_race_sync_thread(transport, shared_clock):
+    """VERDICT r1 weak #6: multiple user threads mutate both replicas
+    while the threaded sync loops run — the lock serialisation must keep
+    states consistent and the pair must converge on every written key."""
+    import threading
+
+    c1 = mk(transport, shared_clock, name="s1", sync_interval=0.01)
+    c2 = mk(transport, shared_clock, name="s2", sync_interval=0.01)
+    c1.set_neighbours([c2])
+    c2.set_neighbours([c1])
+    c1.start()
+    c2.start()
+    try:
+        errs = []
+
+        def writer(rep, base):
+            try:
+                for i in range(50):
+                    if i % 7 == 3:
+                        rep.mutate_async("add", [base + i, i])
+                    else:
+                        rep.mutate("add", [base + i, i], timeout=30)
+                    if i % 11 == 5:
+                        rep.read(timeout=30)
+            except Exception as e:  # surfaced after join
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=writer, args=(rep, base))
+            for rep, base in ((c1, 0), (c2, 1000), (c1, 2000), (c2, 3000))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not errs, errs
+
+        want_keys = {b + i for b in (0, 1000, 2000, 3000) for i in range(50)}
+        import time as _t
+
+        deadline = _t.monotonic() + 60
+        while _t.monotonic() < deadline:
+            r1, r2 = c1.read(timeout=30), c2.read(timeout=30)
+            if r1 == r2 and set(r1) == want_keys:
+                break
+            _t.sleep(0.05)
+        assert set(c1.read()) == want_keys
+        assert c1.read() == c2.read()
+    finally:
+        c1.stop()
+        c2.stop()
