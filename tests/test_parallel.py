@@ -272,3 +272,122 @@ def test_gossip_delta_drive_recovers_from_tier_overflow():
     want = {16 * j + 5: 50 + j for j in range(6)}
     for st in unstack_states(stacked):
         assert _read(st) == want
+
+
+def test_two_pod_bridge_converges():
+    """Two-tier topology (SURVEY §5.8): two 4-device meshes model two
+    ICI pods; the inter-pod (DCN) leg is a host-mediated row-slice
+    exchange — the same extract_rows payload the TCP transport pickles
+    across processes (tests/test_multiprocess.py). Intra-pod divergence
+    heals by ring gossip; one bridged slice per direction converges the
+    pods; a final ring spreads nothing new (n_diff == 0)."""
+    from delta_crdt_ex_tpu.parallel import fanout_merge_into, gossip_delta_drive
+
+    devs = jax.devices()
+    assert len(devs) == 8
+    L = 16
+    pods = []
+    for pod_idx, dev_half in enumerate((devs[:4], devs[4:])):
+        mesh = make_mesh(dev_half)
+        n = len(dev_half)
+        # disjoint writer gids per pod: the pods model distinct processes,
+        # and a shared (gid, ctr) dot identity across pods would let one
+        # pod's context cover (and kill) the other's unrelated entries
+        maps = [
+            BinnedKernelMap(gid=500 * (pod_idx + 1) + i, capacity=64, num_buckets=L)
+            for i in range(n)
+        ]
+        for i, m in enumerate(maps):
+            m.add(100 * pod_idx + i, 1000 + 10 * pod_idx + i, ts=1 + 8 * pod_idx + i)
+        stacked = place_states([m.state for m in maps], mesh)
+        pods.append((mesh, stacked, jnp.zeros(n, jnp.int32)))
+
+    empty = grouped_mutations(4, L, [[] for _ in range(4)])
+
+    def heal(pod):
+        mesh, stacked, slots = pod
+        for _ in range(4):
+            stacked, roots, n_diff, _r = gossip_delta_drive(
+                mesh, stacked, slots, *empty
+            )
+        return (mesh, stacked, slots), int(np.asarray(n_diff).max())
+
+    pods[0], d0 = heal(pods[0])
+    pods[1], d1 = heal(pods[1])
+    assert d0 == 0 and d1 == 0
+
+    # DCN leg: full-row slice of one replica per pod, merged into every
+    # replica of the other pod in one vmapped call
+    all_rows = jnp.arange(L, dtype=jnp.int32)
+    from delta_crdt_ex_tpu.ops.binned import extract_rows as _extract
+
+    # device_get = the host hop: a real deployment pickles these numpy
+    # arrays over TCP (DCN); device arrays cannot cross mesh boundaries
+    to_host = lambda sl: jax.tree_util.tree_map(lambda x: np.asarray(x), sl)
+    sl_a = to_host(_extract(unstack_states(pods[0][1])[0], all_rows))
+    sl_b = to_host(_extract(unstack_states(pods[1][1])[0], all_rows))
+    mesh_a, stacked_a, slots_a = pods[0]
+    mesh_b, stacked_b, slots_b = pods[1]
+    stacked_a, _res, _r = fanout_merge_into(stacked_a, sl_b)
+    stacked_b, _res, _r = fanout_merge_into(stacked_b, sl_a)
+    pods = [(mesh_a, stacked_a, slots_a), (mesh_b, stacked_b, slots_b)]
+
+    pods[0], d0 = heal(pods[0])
+    pods[1], d1 = heal(pods[1])
+    assert d0 == 0 and d1 == 0
+
+    want = {100 * p + i: 1000 + 10 * p + i for p in (0, 1) for i in range(4)}
+    for _mesh, stacked, _slots in pods:
+        for st in unstack_states(stacked):
+            assert _read(st) == want
+
+
+def test_gossip_delta_step_randomized_oracle():
+    """Randomized multi-step convergence of the bounded-divergence SPMD
+    path against a per-replica sequential oracle: random per-replica
+    writes each step (distinct key spaces so LWW ties never depend on
+    replica order), interleaved with delta-gossip; after healing, every
+    replica must read the union of all writes. Tier overflow mid-run is
+    expected (bins fill up as keys spread) — the drive grows and replays."""
+    from delta_crdt_ex_tpu.parallel import gossip_delta_drive
+
+    n = len(jax.devices())
+    mesh = make_mesh()
+    rng = np.random.default_rng(7)
+    L = 64
+    maps = fresh_states(n, capacity=256, num_buckets=L)
+    stacked = place_states([m.state for m in maps], mesh)
+    self_slot = jnp.zeros(n, jnp.int32)
+
+    expected = {}
+    ts = 1
+    for step in range(5):
+        ops_per_replica = []
+        for i in range(n):
+            ops = []
+            for _ in range(int(rng.integers(0, 4))):
+                key = int(i * 100_000 + rng.integers(0, 40))
+                val = int(rng.integers(0, 1 << 30))
+                ops.append((OP_ADD, key, val, ts))
+                expected[key] = (ts, val)
+                ts += 1
+            ops_per_replica.append(ops)
+        batches = grouped_mutations(n, L, ops_per_replica)
+        stacked, roots, n_diff, _r = gossip_delta_drive(
+            mesh, stacked, self_slot, *batches, frontier=16
+        )
+
+    empty = grouped_mutations(n, L, [[] for _ in range(n)])
+    for _ in range(3 * n):
+        stacked, roots, n_diff, _r = gossip_delta_drive(
+            mesh, stacked, self_slot, *empty, frontier=16
+        )
+        if int(np.asarray(n_diff).max()) == 0:
+            break
+    assert int(np.asarray(n_diff).max()) == 0
+
+    want = {k: v for k, (_ts, v) in expected.items()}
+    roots = np.asarray(roots)
+    assert (roots == roots[0]).all()
+    for st in unstack_states(stacked):
+        assert _read(st) == want
