@@ -29,6 +29,7 @@ jit_merge_slice = jax.jit(
     binned_ops.merge_slice, static_argnames=("kill_budget", "max_inserts")
 )
 jit_extract_rows = jax.jit(binned_ops.extract_rows)
+jit_extract_own_delta = jax.jit(binned_ops.extract_own_delta)
 jit_winners_for_keys = jax.jit(binned_ops.winners_for_keys)
 jit_winner_rows = jax.jit(binned_ops.winner_rows)
 jit_compact_rows = jax.jit(binned_ops.compact_rows)
@@ -96,8 +97,10 @@ class CtxGapError(ValueError):
     (``need_ctx_gap``): growth cannot heal this — the *sender* must fall
     back to a full-row (state-form, ``ctx_lo=0``) slice. A distinct type
     so sync layers that ship delta-intervals can catch it and request the
-    fallback. (The host runtime currently always ships ``ctx_lo=0``
-    state-form slices, so no catcher exists there yet.)"""
+    fallback — the replica runtime's eager delta pushes do exactly that
+    (``runtime/replica.py``: ``_push_deltas`` sends intervals, the
+    ``_handle_entries_inner`` catcher answers a gap with a ``GetDiffMsg``
+    full-row repair)."""
 
 
 def tier_retry_merge(
@@ -202,6 +205,7 @@ class BinnedAWLWWMap:
     clear_all = staticmethod(jit_clear_all)
     merge_slice = staticmethod(jit_merge_slice)
     extract_rows = staticmethod(jit_extract_rows)
+    extract_own_delta = staticmethod(jit_extract_own_delta)
     winners_for_keys = staticmethod(jit_winners_for_keys)
     winner_rows = staticmethod(jit_winner_rows)
     compact_rows = staticmethod(jit_compact_rows)

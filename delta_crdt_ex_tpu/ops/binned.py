@@ -165,10 +165,15 @@ def row_apply(
     is_add = (op == OP_ADD) & valid[:, None]
     is_touch = is_add | ((op == OP_REMOVE) & valid[:, None])
 
-    # fresh dot counters, one sequence per replica (Dots.next_dot)
-    base = state.own_counter(self_slot)
-    add_rank = jnp.cumsum(is_add.reshape(-1).astype(jnp.uint32)).reshape(u, m)
-    ctr_assigned = base + add_rank
+    # fresh dot counters: one contiguous sequence per (replica, bucket)
+    # (dot identity is (writer, bucket, counter) — unique because a dot's
+    # bucket is a function of its key). Per-bucket sequences make every
+    # own-delta an exact per-bucket interval (ctx_lo, ctx_max], which is
+    # what lets the runtime push delta-interval slices (Almeida et al.'s
+    # delta mode) instead of full-row state slices.
+    base = state.ctx_max[rows_clip, self_slot]  # [U] own max per bucket
+    add_rank = jnp.cumsum(is_add.astype(jnp.uint32), axis=1)
+    ctr_assigned = base[:, None] + add_rank
 
     # batch-internal shadowing: a later same-key touch kills op (u, m)
     later = jnp.triu(jnp.ones((m, m), bool), 1)
@@ -321,6 +326,42 @@ def extract_rows(state: BinnedStore, rows: jnp.ndarray) -> RowSlice:
         ctx_rows=state.ctx_max[rows_clip] * valid[:, None].astype(jnp.uint32),
         ctx_lo=jnp.zeros_like(state.ctx_max[rows_clip]),
         ctx_gid=state.ctx_gid,
+    )
+
+
+def extract_own_delta(
+    state: BinnedStore,
+    rows: jnp.ndarray,  # int32[U] bucket rows (-1 pads)
+    self_slot: jnp.ndarray,  # int32 scalar
+    gid_self: jnp.ndarray,  # uint64 scalar (== ctx_gid[self_slot])
+    lo: jnp.ndarray,  # uint32[U] per-row interval lower bound (exclusive)
+) -> RowSlice:
+    """Gather an OWN-writer delta-interval slice: this replica's alive
+    entries with counter in ``(lo, ctx_max]`` per bucket row, claiming
+    exactly that interval (Almeida et al.'s delta mode — the delta a
+    replica pushes eagerly instead of waiting for a digest walk to
+    locate it). Minted-but-superseded counters inside the interval read
+    as observed removes, which is their meaning. The slice's writer
+    table is just ``[gid_self]``; shipped entries' node column is 0."""
+    L = state.num_buckets
+    valid = rows >= 0
+    rows_clip = jnp.clip(rows, 0, L - 1)
+    v = valid[:, None]
+    g = _gather_rows(state, rows_clip)
+    own = g["node"] == self_slot
+    alive = state.alive[rows_clip] & v & own & (g["ctr"] > lo[:, None])
+    hi = state.ctx_max[rows_clip, self_slot] * valid.astype(jnp.uint32)
+    return RowSlice(
+        rows=rows,
+        key=g["key"],
+        valh=g["valh"],
+        ts=g["ts"],
+        node=jnp.zeros_like(g["node"]),
+        ctr=g["ctr"],
+        alive=alive,
+        ctx_rows=hi[:, None],
+        ctx_lo=(lo * valid.astype(jnp.uint32))[:, None],
+        ctx_gid=gid_self[None],
     )
 
 

@@ -44,7 +44,7 @@ from delta_crdt_ex_tpu.utils.hashing import (
     value_hash32_batch,
 )
 from delta_crdt_ex_tpu.models.binned import BinnedStore, pow2_tier
-from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap
+from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap, CtxGapError
 from delta_crdt_ex_tpu.ops.apply import OP_ADD, OP_CLEAR, OP_PAD, OP_REMOVE
 from delta_crdt_ex_tpu.runtime import sync as sync_proto, telemetry, tracing
 from delta_crdt_ex_tpu.runtime.clock import Clock
@@ -81,6 +81,7 @@ class Replica:
         levels_per_round: int = 8,
         sync_timeout: float | None = None,
         checkpoint_interval: float = 5.0,
+        eager_deltas: bool = True,
     ):
         # max_sync_size validation (reference raises, causal_crdt.ex:52-62)
         if max_sync_size == "infinite":
@@ -110,9 +111,18 @@ class Replica:
             sync_timeout if sync_timeout is not None else max(10 * sync_interval, 2.0)
         )
 
+        self.eager_deltas = eager_deltas
         self._lock = threading.RLock()
         self._pending: list[tuple[str, Any, Any]] = []  # (op, key_term, value)
-        self._payloads: dict[tuple[int, int], tuple[Any, Any]] = {}
+        #: per-neighbour per-bucket own counter already pushed (Almeida's
+        #: delta mode); soft state — reset on restart, pushes re-cover
+        self._push_cursor: dict[Any, np.ndarray] = {}
+        #: host cache of ctx_max[:, self_slot]; invalidated when local
+        #: mutations mint dots (idle sync ticks then do no device work)
+        self._own_ctr_cache: np.ndarray | None = None
+        # dot (gid, bucket, ctr) -> (key_term, value); counters are
+        # per-(writer, bucket) sequences, so the bucket is part of identity
+        self._payloads: dict[tuple[int, int, int], tuple[Any, Any]] = {}
         self._key_terms: dict[int, Any] = {}
         self._neighbours: list[Any] = []
         self._monitors: set[Any] = set()
@@ -274,9 +284,11 @@ class Replica:
             gid = np.asarray(w.gid)
             ctr = np.asarray(w.ctr)
             out = {}
+            mask = self.num_buckets - 1
             for i, term in enumerate(key_terms):
                 if found[i]:
-                    out[term] = self._payloads[(int(gid[i]), int(ctr[i]))][1]
+                    dot = (int(gid[i]), int(hashes[i]) & mask, int(ctr[i]))
+                    out[term] = self._payloads[dot][1]
             return out
 
     def set_neighbours(self, neighbours: list) -> None:
@@ -291,6 +303,9 @@ class Replica:
             self._neighbours = list(addrs)
             self._monitors &= set(addrs)
             self._outstanding = {a: v for a, v in self._outstanding.items() if a in addrs}
+            self._push_cursor = {
+                a: c for a, c in self._push_cursor.items() if a in addrs
+            }
             self.sync_to_all()
 
     # ------------------------------------------------------------------
@@ -370,6 +385,8 @@ class Replica:
         # register payloads for surviving adds (host mirror of the kernel's
         # shadowing: last op per key wins, a clear shadows everything
         # before it). Keyed by key hash: terms may be unhashable.
+        # Dot identity is (writer gid, bucket, counter) — counters are
+        # per-bucket sequences (ops/binned.py row_apply).
         survivor: dict[int, int] = {}
         blocked = False
         for i in range(n - 1, -1, -1):
@@ -378,10 +395,11 @@ class Replica:
                 blocked = True
             elif not blocked and int(key[i]) not in survivor:
                 survivor[int(key[i])] = i if f == "add" else -1
-        for _kh, i in survivor.items():
+        for kh, i in survivor.items():
             if i >= 0:
                 _f, key_term, value = batch[i]
-                self._payloads[(self.node_id, int(ctr_of_op[i]))] = (key_term, value)
+                dot = (self.node_id, kh & (self.num_buckets - 1), int(ctr_of_op[i]))
+                self._payloads[dot] = (key_term, value)
 
         if need_winners:
             w_after = self._batch_winner_records(touched, any_clear)
@@ -407,6 +425,7 @@ class Replica:
                 self.state = res.state
                 break
             self._grow_bin()
+        self._own_ctr_cache = None  # fresh own dots: push cursors lag
         urow, cols = g.index
         ctr_out[:] = np.asarray(res.ctr_assigned)[urow, cols]
         return int(res.n_keys_changed)
@@ -512,12 +531,13 @@ class Replica:
         value emits a remove diff."""
         internal_changed = 0
         diffs = []
+        mask = self.num_buckets - 1
         for kh, term in touched.items():
             b, a = before.get(kh), after.get(kh)
             if b != a:
                 internal_changed += 1
-            old_rec = self._payloads.get((b[0], b[1])) if b else None
-            new_rec = self._payloads.get((a[0], a[1])) if a else None
+            old_rec = self._payloads.get((b[0], kh & mask, b[1])) if b else None
+            new_rec = self._payloads.get((a[0], kh & mask, a[1])) if a else None
             old_val = old_rec[1] if old_rec else None
             new_val = new_rec[1] if new_rec else None
             if old_val == new_val:
@@ -549,11 +569,14 @@ class Replica:
 
     def _read_all_items(self) -> list[tuple[Any, Any]]:
         key, gid, ctr, _valh, _ts = self._winner_arrays_rows(None)
+        bucket = (key & np.uint64(self.num_buckets - 1)).astype(np.int64)
         key_terms = self._key_terms
         payloads = self._payloads
         return [
             (key_terms[kh], payloads[dot][1])
-            for kh, dot in zip(key.tolist(), zip(gid.tolist(), ctr.tolist()))
+            for kh, dot in zip(
+                key.tolist(), zip(gid.tolist(), bucket.tolist(), ctr.tolist())
+            )
         ]
 
     def read_items(self) -> list[tuple[Any, Any]]:
@@ -574,10 +597,14 @@ class Replica:
 
     def sync_to_all(self) -> None:
         """One sync round to all monitored neighbours (reference
-        ``sync_interval_or_state_to_all``, ``causal_crdt.ex:252-289``)."""
+        ``sync_interval_or_state_to_all``, ``causal_crdt.ex:252-289``):
+        first push any own fresh deltas directly (delta mode — the walk
+        then usually finds the trees already equal), then open the
+        digest-walk round (the repair + transitive-relay path)."""
         with self._lock:
             self._flush()
             self._monitor_neighbours()
+            self._push_deltas()
             tree = self._ensure_tree()
             root = np.zeros(1, np.int64)
             now = time.monotonic()
@@ -595,6 +622,65 @@ class Replica:
                     self._outstanding[n] = now + self.sync_timeout
                 else:
                     logger.debug("tried to sync with a dead neighbour: %r", n)
+
+    def _push_deltas(self) -> None:
+        """Eagerly push this replica's own fresh dots to each neighbour as
+        delta-interval slices (Almeida et al.'s delta mode): per neighbour
+        a per-bucket cursor tracks the highest own counter already pushed;
+        buckets with newer counters ship their ``(cursor, ctx_max]``
+        interval directly — O(delta), no walk rounds. A lost push leaves
+        the next one non-contiguous at the receiver, which answers with a
+        ``GetDiffMsg`` repair (see ``_handle_entries_inner``). Bounded by
+        ``max_sync_size`` bucket rows per neighbour per tick."""
+        if not self.eager_deltas:
+            return
+        if self._own_ctr_cache is None:
+            self._own_ctr_cache = np.asarray(self.state.ctx_max[:, self.self_slot])
+        own = self._own_ctr_cache
+        limit = int(min(self.max_sync_size, self.num_buckets))
+
+        # group neighbours by cursor value: in steady state every cursor
+        # is identical, so the slice extraction + payload gather happen
+        # once and the same message body fans out to all of them
+        groups: dict[bytes, list] = {}
+        for n in list(self._monitors):
+            if n == self.addr:
+                continue
+            cur = self._push_cursor.get(n)
+            if cur is None:
+                cur = np.zeros(self.num_buckets, np.uint32)
+                self._push_cursor[n] = cur
+            groups.setdefault(cur.tobytes(), []).append((n, cur))
+
+        for members in groups.values():
+            cur0 = members[0][1]
+            pending = np.nonzero(own > cur0)[0]
+            if len(pending) == 0:
+                continue
+            pending = pending[:limit]
+            rows = np.full(_pow2(max(len(pending), 1)), -1, np.int32)
+            rows[: len(pending)] = pending
+            lo = np.zeros(len(rows), np.uint32)
+            lo[: len(pending)] = cur0[pending]
+            sl = self.model.extract_own_delta(
+                self.state,
+                jnp.asarray(rows),
+                jnp.int32(self.self_slot),
+                jnp.uint64(self.node_id),
+                jnp.asarray(lo),
+            )
+            arrays, payloads = self._slice_wire(sl, rows)
+            for n, cur in members:
+                msg = sync_proto.EntriesMsg(
+                    originator=self.addr,
+                    frm=self.addr,
+                    to=n,
+                    buckets=pending.astype(np.int64),
+                    arrays=arrays,
+                    payloads=payloads,
+                )
+                if self.transport.send(n, msg):
+                    cur[pending] = own[pending]
 
     def _monitor_neighbours(self) -> None:
         for n in self._neighbours:
@@ -667,22 +753,29 @@ class Replica:
         self._send_entries(to=msg.frm, buckets=msg.buckets, originator=msg.originator)
         self._outstanding.pop(msg.frm, None)
 
-    def _send_entries(self, to, buckets: np.ndarray, originator) -> None:
-        rows = np.full(_pow2(max(len(buckets), 1)), -1, np.int32)
-        rows[: len(buckets)] = np.asarray(buckets, np.int32)
-        sl = self.model.extract_rows(self.state, jnp.asarray(rows))
+    def _slice_wire(self, sl, rows: np.ndarray) -> tuple[dict, dict]:
+        """Serialise a RowSlice to the EntriesMsg wire format: the numpy
+        column arrays (context rows for exactly the shipped buckets —
+        bucket-atomic sync: coverage never outruns content) plus the
+        payload dict of every alive dot in the slice."""
         arrays = {c: np.asarray(getattr(sl, c)) for c in _SLICE_COLUMNS}
         arrays["rows"] = rows
-        # context rows for exactly the synced buckets (bucket-atomic sync:
-        # coverage never outruns the shipped entries)
         arrays["ctx_rows"] = np.asarray(sl.ctx_rows)
+        arrays["ctx_lo"] = np.asarray(sl.ctx_lo)
         arrays["ctx_gid"] = np.asarray(sl.ctx_gid)
         gids = arrays["ctx_gid"][arrays["node"]]
         payloads = {}
         u_idx, b_idx = np.nonzero(arrays["alive"])
         for u, b in zip(u_idx, b_idx):
-            dot = (int(gids[u, b]), int(arrays["ctr"][u, b]))
+            dot = (int(gids[u, b]), int(rows[u]), int(arrays["ctr"][u, b]))
             payloads[dot] = self._payloads[dot]
+        return arrays, payloads
+
+    def _send_entries(self, to, buckets: np.ndarray, originator) -> None:
+        rows = np.full(_pow2(max(len(buckets), 1)), -1, np.int32)
+        rows[: len(buckets)] = np.asarray(buckets, np.int32)
+        sl = self.model.extract_rows(self.state, jnp.asarray(rows))
+        arrays, payloads = self._slice_wire(sl, rows)
         self.transport.send(
             to,
             sync_proto.EntriesMsg(
@@ -703,6 +796,7 @@ class Replica:
         self._flush()
         t0 = time.perf_counter()
         a = msg.arrays
+        ctx_rows = jnp.asarray(a["ctx_rows"])
         sl = self.model.RowSlice(
             rows=jnp.asarray(a["rows"]),
             key=jnp.asarray(a["key"]),
@@ -711,9 +805,10 @@ class Replica:
             node=jnp.asarray(a["node"]),
             ctr=jnp.asarray(a["ctr"]),
             alive=jnp.asarray(a["alive"]),
-            ctx_rows=jnp.asarray(a["ctx_rows"]),
-            # anti-entropy ships full-row state slices: interval lo = 0
-            ctx_lo=jnp.zeros_like(jnp.asarray(a["ctx_rows"])),
+            ctx_rows=ctx_rows,
+            # walk-located transfers ship full-row state slices (lo = 0);
+            # eager delta pushes carry their exact interval lower bounds
+            ctx_lo=jnp.asarray(a["ctx_lo"]),
             ctx_gid=jnp.asarray(a["ctx_gid"]),
         )
         rows_np = a["rows"]
@@ -730,7 +825,25 @@ class Replica:
         for _dot, (key_term, _val) in msg.payloads.items():
             self._key_terms[key_hash64(key_term)] = key_term
 
-        res = self._merge_with_growth(sl, n_alive=int(np.sum(a["alive"])))
+        try:
+            res = self._merge_with_growth(sl, n_alive=int(np.sum(a["alive"])))
+        except CtxGapError:
+            # a delta-interval push is not contiguous with our context (an
+            # earlier push was lost): ask the sender for the full rows —
+            # the get_diff repair path (``causal_crdt.ex:112-123``)
+            logger.debug(
+                "delta push from %r gapped; requesting full rows", msg.frm
+            )
+            self.transport.send(
+                msg.frm,
+                sync_proto.GetDiffMsg(
+                    originator=self.addr,
+                    frm=self.addr,
+                    to=msg.frm,
+                    buckets=np.asarray(msg.buckets),
+                ),
+            )
+            return
 
         self._seq += 1
         if want_diffs:
@@ -803,7 +916,8 @@ class Replica:
             gids = np.asarray(self.state.ctx_gid)[node]
             u_idx, b_idx = np.nonzero(alive)
             live = {
-                (int(gids[u, b]), int(ctr[u, b])) for u, b in zip(u_idx, b_idx)
+                (int(gids[u, b]), int(u), int(ctr[u, b]))
+                for u, b in zip(u_idx, b_idx)
             }
             self._payloads = {d: p for d, p in self._payloads.items() if d in live}
             keep_keys = {int(keyarr[u, b]) for u, b in zip(u_idx, b_idx)}

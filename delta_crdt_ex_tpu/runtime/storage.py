@@ -27,7 +27,7 @@ import numpy as np
 
 #: engine layout written by the current replica driver; bumped whenever
 #: the column set / array shapes change incompatibly
-CURRENT_LAYOUT = "binned-v1"
+CURRENT_LAYOUT = "binned-v2"  # v2: payload dots keyed (gid, bucket, ctr); per-bucket counters
 
 
 @dataclasses.dataclass
@@ -37,7 +37,7 @@ class Snapshot:
     node_id: int  # dot-namespace continuity across restarts
     sequence_number: int  # number of applied mutation batches
     arrays: dict[str, np.ndarray]  # DotStore columns + ctx tables
-    payloads: dict[tuple[int, int], tuple[Any, Any]]  # dot -> (key_term, value)
+    payloads: dict[tuple[int, int, int], tuple[Any, Any]]  # (gid, bucket, ctr) -> (key_term, value)
     key_terms: dict[int, Any]  # key hash -> key term
     last_ts: int  # clock continuity (LWW monotonicity)
     layout: str = CURRENT_LAYOUT  # engine layout tag (rehydrate checks it)

@@ -63,7 +63,7 @@ class EntriesMsg:
     to: Hashable
     buckets: np.ndarray
     arrays: dict[str, np.ndarray]  # DotStore slice columns + ctx tables
-    payloads: dict[tuple[int, int], tuple[Any, Any]]  # dot -> (key_term, value)
+    payloads: dict[tuple[int, int, int], tuple[Any, Any]]  # (gid, bucket, ctr) -> (key_term, value)
 
 
 @dataclasses.dataclass

@@ -120,7 +120,7 @@ def test_rehydrate_rejects_foreign_layout(transport, shared_clock):
     c = mk(transport, shared_clock, name="laytag", storage_module=store)
     c.mutate("add", ["k", "v"])
     snap = store.read("laytag")
-    assert snap.layout == "binned-v1"
+    assert snap.layout == "binned-v2"
     c.stop()
     c.transport.unregister("laytag")
     store.write("laytag", dataclasses.replace(snap, layout="flat-v0"))

@@ -358,3 +358,53 @@ def test_concurrent_mutators_race_sync_thread(transport, shared_clock):
     finally:
         c1.stop()
         c2.stop()
+
+
+def test_eager_delta_push_converges_in_one_message(transport, shared_clock):
+    """Almeida's delta mode: a replica's own fresh dots arrive at a
+    neighbour as ONE direct delta-interval EntriesMsg — no digest-walk
+    ping-pong rounds needed for own writes."""
+    from delta_crdt_ex_tpu.runtime import sync as sync_proto
+
+    c1 = mk(transport, shared_clock)
+    c2 = mk(transport, shared_clock)
+    c1.mutate("add", ["x", 1])
+    c1.mutate("add", ["y", 2])
+    c1.set_neighbours([c2])  # immediate sync: push + walk open
+
+    msgs = transport.drain(c2.addr)
+    pushes = [m for m in msgs if isinstance(m, sync_proto.EntriesMsg)]
+    assert pushes, f"no delta push among {[type(m).__name__ for m in msgs]}"
+    c2.handle(pushes[0])
+    assert c2.read() == {"x": 1, "y": 2}
+
+
+def test_lost_push_heals_via_get_diff_repair(transport, shared_clock):
+    """A lost delta push leaves the next one non-contiguous: the receiver
+    detects the gap (need_ctx_gap) and requests the full rows — the
+    get_diff repair path."""
+    from delta_crdt_ex_tpu.runtime import sync as sync_proto
+
+    c1 = mk(transport, shared_clock)
+    c2 = mk(transport, shared_clock)
+    c1.set_neighbours([c2])
+    converge(transport, [c1, c2])
+
+    c1.mutate("add", ["k", 1])
+    c1.sync_to_all()
+    transport.drain(c2.addr)  # the push (and walk open) are LOST
+
+    c1.mutate("add", ["k", 2])  # same bucket: counter advances past the gap
+    c1.sync_to_all()
+    msgs = transport.drain(c2.addr)
+    pushes = [m for m in msgs if isinstance(m, sync_proto.EntriesMsg)]
+    assert pushes and int(pushes[0].arrays["ctx_lo"].max()) > 0  # a true delta interval
+    c2.handle(pushes[0])  # gap detected -> repair request
+    assert c2.read().get("k") is None  # gapped push was not applied
+    gets = [m for m in transport.drain(c1.addr) if isinstance(m, sync_proto.GetDiffMsg)]
+    assert gets, "receiver must request full rows on a gapped push"
+    c1.handle(gets[0])
+    ents = [m for m in transport.drain(c2.addr) if isinstance(m, sync_proto.EntriesMsg)]
+    assert ents
+    c2.handle(ents[0])
+    assert c2.read()["k"] == 2
