@@ -135,6 +135,8 @@ class RowApplyResult(NamedTuple):
     ok: jnp.ndarray  # bool: every touched row had bin space
     ctr_assigned: jnp.ndarray  # uint32[U, M] dot counter per add op
     n_keys_changed: jnp.ndarray  # int32 (telemetry keys_updated_count)
+    row_killed: jnp.ndarray  # bool[U]: row lost a pre-batch entry (a kill
+    # cannot ride a delta-interval push — the host full-row-pushes these)
 
 
 def row_apply(
@@ -255,7 +257,9 @@ def row_apply(
     changed = is_touch & first_occ & (ins | killed_any)
     n_keys_changed = jnp.sum(changed.astype(jnp.int32))
 
-    return RowApplyResult(new_state, ok, ctr_assigned, n_keys_changed)
+    return RowApplyResult(
+        new_state, ok, ctr_assigned, n_keys_changed, jnp.any(killed, axis=1)
+    )
 
 
 def clear_all(state: BinnedStore) -> BinnedStore:

@@ -120,6 +120,12 @@ class Replica:
         #: host cache of ctx_max[:, self_slot]; invalidated when local
         #: mutations mint dots (idle sync ticks then do no device work)
         self._own_ctr_cache: np.ndarray | None = None
+        #: removes/clears don't mint dots, so interval pushes can't carry
+        #: them; rows touched by local removes get a monotone sequence
+        #: stamp and are pushed as full-row state slices instead
+        self._row_touch_seq = np.zeros(self.num_buckets, np.int64)
+        self._touch_seq = 0
+        self._rm_cursor: dict[Any, int] = {}
         # dot (gid, bucket, ctr) -> (key_term, value); counters are
         # per-(writer, bucket) sequences, so the bucket is part of identity
         self._payloads: dict[tuple[int, int, int], tuple[Any, Any]] = {}
@@ -306,6 +312,7 @@ class Replica:
             self._push_cursor = {
                 a: c for a, c in self._push_cursor.items() if a in addrs
             }
+            self._rm_cursor = {a: c for a, c in self._rm_cursor.items() if a in addrs}
             self.sync_to_all()
 
     # ------------------------------------------------------------------
@@ -381,6 +388,9 @@ class Replica:
                     n_changed += n_cleared
                 seg_start = i + 1
         self._seq += 1
+        if any_clear:
+            # a clear kills every row; stamp them all for the full-row push
+            self._stamp_rows(np.arange(self.num_buckets, dtype=np.int64))
 
         # register payloads for surviving adds (host mirror of the kernel's
         # shadowing: last op per key wins, a clear shadows everything
@@ -426,9 +436,27 @@ class Replica:
                 break
             self._grow_bin()
         self._own_ctr_cache = None  # fresh own dots: push cursors lag
+        # rows that lost a pre-batch entry (removes AND overwriting adds)
+        # cannot converge via the interval push alone — stamp them for the
+        # full-row push leg
+        killed_mask = np.asarray(res.row_killed)
+        self._stamp_rows(g.rows[killed_mask & (g.rows >= 0)])
         urow, cols = g.index
         ctr_out[:] = np.asarray(res.ctr_assigned)[urow, cols]
         return int(res.n_keys_changed)
+
+    def _stamp_rows(self, rows: np.ndarray) -> None:
+        """Mark rows as needing a full-row push, each with a UNIQUE
+        monotone stamp — uniqueness lets a truncated push advance its
+        cursor to exactly the last pushed row (no livelock on ties)."""
+        if len(rows) == 0:
+            return
+        rows = np.unique(rows)
+        k = len(rows)
+        self._row_touch_seq[rows] = np.arange(
+            self._touch_seq + 1, self._touch_seq + 1 + k, dtype=np.int64
+        )
+        self._touch_seq += k
 
     def _grow_bin(self) -> None:
         self.state = self.state.grow(bin_capacity=self.state.bin_capacity * 2)
@@ -682,6 +710,39 @@ class Replica:
                 if self.transport.send(n, msg):
                     cur[pending] = own[pending]
 
+        # full-row pushes for kill-touched rows (removes, clears and
+        # overwriting adds — kills cannot ride an interval). Oldest unique
+        # stamps first, so a truncated push advances the cursor to exactly
+        # the last pushed row; neighbours with equal cursors share one
+        # extraction like the delta leg above.
+        rm_groups: dict[int, list] = {}
+        for n in list(self._monitors):
+            if n == self.addr:
+                continue
+            rm_groups.setdefault(self._rm_cursor.get(n, 0), []).append(n)
+        for rc, members in rm_groups.items():
+            pend = np.nonzero(self._row_touch_seq > rc)[0]
+            if len(pend) == 0:
+                continue
+            order = np.argsort(self._row_touch_seq[pend], kind="stable")
+            pend = pend[order][:limit]
+            new_cursor = int(self._row_touch_seq[pend[-1]])
+            rows = np.full(_pow2(max(len(pend), 1)), -1, np.int32)
+            rows[: len(pend)] = pend
+            sl = self.model.extract_rows(self.state, jnp.asarray(rows))
+            arrays, payloads = self._slice_wire(sl, rows)
+            for n in members:
+                msg = sync_proto.EntriesMsg(
+                    originator=self.addr,
+                    frm=self.addr,
+                    to=n,
+                    buckets=pend.astype(np.int64),
+                    arrays=arrays,
+                    payloads=payloads,
+                )
+                if self.transport.send(n, msg):
+                    self._rm_cursor[n] = new_cursor
+
     def _monitor_neighbours(self) -> None:
         for n in self._neighbours:
             if n in self._monitors:
@@ -771,12 +832,12 @@ class Replica:
             payloads[dot] = self._payloads[dot]
         return arrays, payloads
 
-    def _send_entries(self, to, buckets: np.ndarray, originator) -> None:
+    def _send_entries(self, to, buckets: np.ndarray, originator) -> bool:
         rows = np.full(_pow2(max(len(buckets), 1)), -1, np.int32)
         rows[: len(buckets)] = np.asarray(buckets, np.int32)
         sl = self.model.extract_rows(self.state, jnp.asarray(rows))
         arrays, payloads = self._slice_wire(sl, rows)
-        self.transport.send(
+        return self.transport.send(
             to,
             sync_proto.EntriesMsg(
                 originator=originator,

@@ -408,3 +408,55 @@ def test_lost_push_heals_via_get_diff_repair(transport, shared_clock):
     assert ents
     c2.handle(ents[0])
     assert c2.read()["k"] == 2
+
+
+def test_eager_remove_push_converges_in_one_message(transport, shared_clock):
+    """Removes mint no dots, so they ride the full-row push leg: after a
+    local remove, one EntriesMsg (state-form, lo=0) carries it to the
+    neighbour without walk rounds."""
+    from delta_crdt_ex_tpu.runtime import sync as sync_proto
+
+    c1 = mk(transport, shared_clock)
+    c2 = mk(transport, shared_clock)
+    c1.set_neighbours([c2])
+    c1.mutate("add", ["k", 1])
+    converge(transport, [c1, c2])
+    assert c2.read() == {"k": 1}
+
+    c1.mutate("remove", ["k"])
+    c1.sync_to_all()
+    msgs = transport.drain(c2.addr)
+    ents = [m for m in msgs if isinstance(m, sync_proto.EntriesMsg)]
+    assert ents, f"no push among {[type(m).__name__ for m in msgs]}"
+    for m in ents:
+        c2.handle(m)
+    assert c2.read() == {}
+
+
+def test_clear_push_cursor_advances_without_livelock(transport, shared_clock):
+    """A clear stamps every bucket; with max_sync_size truncation the
+    remove-push cursor must still advance each tick (unique stamps) and
+    go quiet once everything is pushed — no perpetual resends."""
+    from delta_crdt_ex_tpu.runtime import sync as sync_proto
+
+    c1 = mk(transport, shared_clock, max_sync_size=8)
+    c2 = mk(transport, shared_clock, max_sync_size=8)
+    c1.set_neighbours([c2])
+    for i in range(20):
+        c1.mutate("add", [i, i])
+    converge(transport, [c1, c2])
+    assert len(c2.read()) == 20
+
+    c1.mutate("clear", [])
+    # 64 buckets / 8 per tick = 8 ticks to drain the stamps
+    for _ in range(12):
+        c1.sync_to_all()
+        transport.pump()
+    assert c2.read() == {}
+    assert c1._rm_cursor[c2.addr] == c1._touch_seq
+    # quiet: a further tick sends no entries
+    c1.sync_to_all()
+    msgs = transport.drain(c2.addr)
+    assert not any(isinstance(m, sync_proto.EntriesMsg) for m in msgs), (
+        "push leg must go quiet once cursors catch up"
+    )
