@@ -83,6 +83,10 @@ def bench_tpu(seed=0):
     import jax
     import jax.numpy as jnp
 
+    from delta_crdt_ex_tpu.utils.devices import enable_compilation_cache
+
+    log(f"compilation cache: {enable_compilation_cache()}")
+
     from delta_crdt_ex_tpu.ops.binned import merge_slice
     from delta_crdt_ex_tpu.utils.synth import build_state, interval_delta_stream
 

@@ -12,6 +12,10 @@ from delta_crdt_ex_tpu.runtime.transport import LocalTransport
 
 log = lambda *a: print(*a, file=sys.stderr, flush=True)
 
+from delta_crdt_ex_tpu.utils.devices import enable_compilation_cache
+
+enable_compilation_cache()
+
 
 def make_pair(transport=None, **opts):
     """Two deterministic replicas wired bidirectionally."""

@@ -19,7 +19,7 @@ from functools import partial
 import jax
 import numpy as np
 
-from delta_crdt_ex_tpu.models.binned import BinnedStore, pow2_tier as _pow2
+from delta_crdt_ex_tpu.models.binned import BinnedStore, pow2_tier as _pow2, pow4_tier as _pow4
 from delta_crdt_ex_tpu.ops import binned as binned_ops
 from delta_crdt_ex_tpu.ops.apply import OP_ADD, OP_CLEAR, OP_PAD, OP_REMOVE
 
@@ -182,7 +182,7 @@ def merge_into(
         lambda st, s, kb, mi: jit_merge_slice(st, s, kill_budget=kb, max_inserts=mi),
         jit_compact_rows,
         kill_budget,
-        _pow2(max(n_alive, 1)),
+        _pow4(max(n_alive, 1)),
         on_grow=on_grow,
     )
     return new_state, res

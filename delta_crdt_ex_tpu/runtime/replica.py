@@ -43,7 +43,7 @@ from delta_crdt_ex_tpu.utils.hashing import (
     value_hash32,
     value_hash32_batch,
 )
-from delta_crdt_ex_tpu.models.binned import BinnedStore, pow2_tier
+from delta_crdt_ex_tpu.models.binned import BinnedStore, pow2_tier, pow4_tier
 from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap, CtxGapError
 from delta_crdt_ex_tpu.ops.apply import OP_ADD, OP_CLEAR, OP_PAD, OP_REMOVE
 from delta_crdt_ex_tpu.runtime import sync as sync_proto, telemetry, tracing
@@ -59,6 +59,12 @@ _SLICE_COLUMNS = ("key", "valh", "ts", "node", "ctr", "alive")
 
 def _pow2(n: int, floor: int = 8) -> int:
     return pow2_tier(n, floor)
+
+
+#: wire tier (x4 steps): every data-dependent slice/query shape goes
+#: through this so the distinct-compile count stays small (pow4_tier doc)
+def _wire(n: int, floor: int = 8) -> int:
+    return pow4_tier(n, floor)
 
 
 class Replica:
@@ -282,7 +288,7 @@ class Replica:
         with self._lock:
             self._flush()
             hashes = [key_hash64(k) for k in key_terms]
-            k = _pow2(max(len(hashes), 1))
+            k = _wire(max(len(hashes), 1))
             arr = np.zeros(k, np.uint64)
             arr[: len(hashes)] = hashes
             w = self.model.winners_for_keys(self.state, jnp.asarray(arr))
@@ -480,7 +486,7 @@ class Replica:
             return self._winner_records_rows(None)
         if not touched:
             return {}
-        tkeys = np.zeros(_pow2(max(len(touched), 1)), np.uint64)
+        tkeys = np.zeros(_wire(max(len(touched), 1)), np.uint64)
         tkeys[: len(touched)] = list(touched.keys())
         w = self.model.winners_for_keys(self.state, jnp.asarray(tkeys))
         found = np.asarray(w.found)
@@ -508,7 +514,7 @@ class Replica:
         CHUNK = 4096
         for s in range(0, len(rows), CHUNK):
             chunk = rows[s : s + CHUNK]
-            padded = np.full(_pow2(len(chunk)), -1, np.int32)
+            padded = np.full(_pow2(len(chunk)), -1, np.int32)  # constant-shape chunk: exact tier
             padded[: len(chunk)] = chunk
             w = self.model.winner_rows(self.state, jnp.asarray(padded))
             win = np.asarray(w.win)
@@ -686,7 +692,7 @@ class Replica:
             if len(pending) == 0:
                 continue
             pending = pending[:limit]
-            rows = np.full(_pow2(max(len(pending), 1)), -1, np.int32)
+            rows = np.full(_wire(max(len(pending), 1)), -1, np.int32)
             rows[: len(pending)] = pending
             lo = np.zeros(len(rows), np.uint32)
             lo[: len(pending)] = cur0[pending]
@@ -727,7 +733,7 @@ class Replica:
             order = np.argsort(self._row_touch_seq[pend], kind="stable")
             pend = pend[order][:limit]
             new_cursor = int(self._row_touch_seq[pend[-1]])
-            rows = np.full(_pow2(max(len(pend), 1)), -1, np.int32)
+            rows = np.full(_wire(max(len(pend), 1)), -1, np.int32)
             rows[: len(pend)] = pend
             sl = self.model.extract_rows(self.state, jnp.asarray(rows))
             arrays, payloads = self._slice_wire(sl, rows)
@@ -833,7 +839,7 @@ class Replica:
         return arrays, payloads
 
     def _send_entries(self, to, buckets: np.ndarray, originator) -> bool:
-        rows = np.full(_pow2(max(len(buckets), 1)), -1, np.int32)
+        rows = np.full(_wire(max(len(buckets), 1)), -1, np.int32)
         rows[: len(buckets)] = np.asarray(buckets, np.int32)
         sl = self.model.extract_rows(self.state, jnp.asarray(rows))
         arrays, payloads = self._slice_wire(sl, rows)

@@ -71,3 +71,25 @@ def force_cpu_devices(n: int) -> None:
             "the host device count is parsed once per process. Call this "
             "before any jax operation (or run in a fresh process)."
         )
+
+
+def enable_compilation_cache(path: str | None = None) -> str:
+    """Point JAX's persistent compilation cache at a repo-local directory
+    so repeated runs (benches, test sessions, a bench retry after a
+    wedged device claim) reuse compiled executables instead of paying
+    the compile again — critical on remote-compile backends where one
+    compile can cost minutes. Returns the cache directory used."""
+    import os
+
+    import jax
+
+    if path is None:
+        path = os.environ.get(
+            "DELTA_CRDT_JAX_CACHE",
+            os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), ".jax_cache"),
+        )
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    return path

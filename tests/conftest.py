@@ -9,9 +9,10 @@ pre-imports jax at interpreter startup, the platform must be forced via
 # The ambient image pre-imports jax via an axon sitecustomize, so JAX_PLATFORMS
 # env-var writes alone are too late; force_cpu_devices handles the dance
 # (jax.config update + env var for subprocesses).
-from delta_crdt_ex_tpu.utils.devices import force_cpu_devices
+from delta_crdt_ex_tpu.utils.devices import enable_compilation_cache, force_cpu_devices
 
 force_cpu_devices(8)
+enable_compilation_cache()
 
 import pytest  # noqa: E402
 
