@@ -1,0 +1,63 @@
+"""Runtime-level oracle property (the reference's lattice-vs-model
+pattern, ``aw_lww_map_property_test.exs:18-76``, lifted to the FULL
+replica runtime: mutation queue, eager pushes, digest walk, diff feed).
+
+With full convergence after every op, a plain dict is an exact oracle:
+a remove observes every dot (nothing concurrent survives), so add-wins
+semantics coincide with sequential map semantics. Divergence-mode
+properties (partial sync, drops) live in ``test_simnet.py``.
+"""
+
+from hypothesis import given, settings, strategies as st
+
+from delta_crdt_ex_tpu import AWLWWMap
+from delta_crdt_ex_tpu.api import start_link
+from delta_crdt_ex_tpu.runtime.clock import LogicalClock
+from delta_crdt_ex_tpu.runtime.transport import LocalTransport
+from tests.conftest import converge
+
+
+@settings(max_examples=10, deadline=None)
+@given(
+    st.lists(
+        st.tuples(
+            st.integers(min_value=0, max_value=2),  # writer
+            st.sampled_from(["add", "add", "add", "remove", "clear"]),
+            st.integers(min_value=1, max_value=8),  # key
+            st.integers(min_value=0, max_value=99),  # value
+        ),
+        max_size=12,
+    ),
+)
+def test_fully_synced_scripts_match_dict_oracle(script):
+    transport = LocalTransport()
+    clock = LogicalClock()
+    reps = [
+        start_link(
+            AWLWWMap,
+            threaded=False,
+            transport=transport,
+            clock=clock,
+            capacity=64,
+            tree_depth=5,
+        )
+        for _ in range(3)
+    ]
+    for r in reps:
+        r.set_neighbours([x for x in reps if x is not r])
+    converge(transport, reps)
+
+    model: dict = {}
+    for who, op, key, val in script:
+        if op == "add":
+            reps[who].mutate("add", [key, val])
+            model[key] = val
+        elif op == "remove":
+            reps[who].mutate("remove", [key])
+            model.pop(key, None)
+        else:
+            reps[who].mutate("clear", [])
+            model.clear()
+        converge(transport, reps)
+        for r in reps:
+            assert r.read() == model, (op, key, val)

@@ -43,7 +43,7 @@ def build(n_replicas, seed, drop, dup):
     st.lists(
         st.tuples(
             st.integers(min_value=0, max_value=2),  # writer
-            st.sampled_from(["add", "remove"]),
+            st.sampled_from(["add", "add", "remove", "clear"]),
             st.integers(min_value=1, max_value=6),  # key
             st.integers(min_value=0, max_value=50),  # value
         ),
@@ -62,8 +62,10 @@ def test_convergence_under_reordered_and_duplicated_delivery(seed, script):
         if op == "add":
             reps[who].mutate("add", [key, val])
             writes.setdefault(key, set()).add(val)
-        else:
+        elif op == "remove":
             reps[who].mutate("remove", [key])
+        else:
+            reps[who].mutate("clear", [])
         if net.rng.random() < 0.5:
             net.run(reps, rounds=1)
     net.run(reps, rounds=50)
