@@ -460,3 +460,30 @@ def test_clear_push_cursor_advances_without_livelock(transport, shared_clock):
     assert not any(isinstance(m, sync_proto.EntriesMsg) for m in msgs), (
         "push leg must go quiet once cursors catch up"
     )
+
+
+def test_64_neighbour_star_fanout(transport, shared_clock):
+    """North-star topology at the runtime level: one writer with 64
+    neighbours. The grouped delta push extracts once and fans out to all
+    64 (equal cursors); everyone converges in a couple of ticks."""
+    hub = mk(transport, shared_clock, name="hub")
+    leaves = [mk(transport, shared_clock, name=f"leaf{i}") for i in range(64)]
+    hub.set_neighbours(leaves)
+    for k in range(8):
+        hub.mutate("add", [k, k * 10])
+    for _ in range(3):
+        hub.sync_to_all()
+        transport.pump()
+    want = {k: k * 10 for k in range(8)}
+    for leaf in leaves:
+        assert leaf.read() == want
+    # steady state: all cursors equal -> one extraction per tick, and
+    # an idle tick sends nothing
+    hub.sync_to_all()
+    n_entries = sum(
+        1
+        for leaf in leaves
+        for m in transport.drain(leaf.addr)
+        if type(m).__name__ == "EntriesMsg"
+    )
+    assert n_entries == 0, "idle tick must not push"
