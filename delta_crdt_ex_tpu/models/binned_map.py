@@ -28,6 +28,7 @@ jit_clear_all = jax.jit(binned_ops.clear_all)
 jit_merge_slice = jax.jit(
     binned_ops.merge_slice, static_argnames=("kill_budget", "max_inserts")
 )
+jit_merge_rows = jax.jit(binned_ops.merge_rows)
 jit_extract_rows = jax.jit(binned_ops.extract_rows)
 jit_extract_own_delta = jax.jit(binned_ops.extract_own_delta)
 jit_winners_for_keys = jax.jit(binned_ops.winners_for_keys)
@@ -92,6 +93,12 @@ def group_batch(num_buckets: int, op, key, valh, ts) -> GroupedBatch:
     return GroupedBatch(rows, g_op, g_key, g_valh, g_ts, (urow_of, cols))
 
 
+_CTX_GAP_MSG = (
+    "delta-interval slice is not contiguous with the local context; "
+    "re-sync with a full-row slice (ctx_lo=0)"
+)
+
+
 class CtxGapError(ValueError):
     """A delta-interval slice is not contiguous with the local context
     (``need_ctx_gap``): growth cannot heal this — the *sender* must fall
@@ -141,10 +148,7 @@ def tier_retry_merge(
             return res.state, res, retries
         retries += 1
         if bool(np.asarray(res.need_ctx_gap).any()):
-            raise CtxGapError(
-                "delta-interval slice is not contiguous with the local "
-                "context; re-sync with a full-row slice (ctx_lo=0)"
-            )
+            raise CtxGapError(_CTX_GAP_MSG)
         if bool(np.asarray(res.need_gid_grow).any()):
             state = state.grow(replica_capacity=state.replica_capacity * 2)
             if on_grow:
@@ -163,13 +167,39 @@ def tier_retry_merge(
                     on_grow(state)
 
 
+def merge_rows_into(state: BinnedStore, sl, on_grow=None):
+    """Merge a RowSlice via the row-granular kernel
+    (:func:`~delta_crdt_ex_tpu.ops.binned.merge_rows`) — the runtime's
+    merge path: slices there are at most ``max_sync_size`` rows, where
+    row-granular cost equals the element-scatter path but needs no
+    kill-budget or insert tiers (the only escapes left are genuine
+    growth). Returns ``(new_state, last_result)``; raises
+    :class:`CtxGapError` on a non-contiguous delta-interval."""
+    while True:
+        res = jit_merge_rows(state, sl)
+        if bool(res.ok):
+            return res.state, res
+        if bool(res.need_ctx_gap):
+            raise CtxGapError(_CTX_GAP_MSG)
+        if bool(res.need_gid_grow):
+            state = state.grow(replica_capacity=state.replica_capacity * 2)
+            if on_grow:
+                on_grow(state)
+        if bool(res.need_fill_grow):
+            state = state.grow(bin_capacity=state.bin_capacity * 2)
+            if on_grow:
+                on_grow(state)
+
+
 def merge_into(
     state: BinnedStore, sl, kill_budget: int = 16, on_grow=None, n_alive: int | None = None
 ):
     """Merge a :class:`~delta_crdt_ex_tpu.ops.binned.RowSlice` into
-    ``state`` via :func:`tier_retry_merge`. Returns ``(new_state,
-    last_result)``. ``on_grow(state)`` fires after each capacity growth
-    (telemetry hook)."""
+    ``state`` via :func:`tier_retry_merge` over the element-scatter
+    kernel — the bulk fan-in path (cost ∝ slice entries, best for
+    sparse many-row slices like the bench's 8192-row delta groups).
+    Returns ``(new_state, last_result)``. ``on_grow(state)`` fires after
+    each capacity growth (telemetry hook)."""
     # compact the insert scatter to a power-of-two tier of the slice's
     # alive count (scatter cost is per index entry; the [U, S] grid is
     # mostly padding); callers that built the slice from host arrays pass
@@ -204,6 +234,7 @@ class BinnedAWLWWMap:
     row_apply = staticmethod(jit_row_apply)
     clear_all = staticmethod(jit_clear_all)
     merge_slice = staticmethod(jit_merge_slice)
+    merge_rows = staticmethod(jit_merge_rows)
     extract_rows = staticmethod(jit_extract_rows)
     extract_own_delta = staticmethod(jit_extract_own_delta)
     winners_for_keys = staticmethod(jit_winners_for_keys)
@@ -211,4 +242,5 @@ class BinnedAWLWWMap:
     compact_rows = staticmethod(jit_compact_rows)
     tree_from_leaves = staticmethod(jit_tree_from_leaves)
     merge_into = staticmethod(merge_into)
+    merge_rows_into = staticmethod(merge_rows_into)
     RowSlice = binned_ops.RowSlice

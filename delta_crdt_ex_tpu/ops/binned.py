@@ -619,6 +619,148 @@ def merge_slice(
     )
 
 
+class MergeRowsResult(NamedTuple):
+    state: BinnedStore
+    ok: jnp.ndarray  # bool: result valid
+    need_gid_grow: jnp.ndarray  # bool: unknown writer gids overflowed R
+    need_fill_grow: jnp.ndarray  # bool: survivors + inserts exceed B
+    need_ctx_gap: jnp.ndarray  # bool: delta-interval not contiguous
+    n_inserted: jnp.ndarray  # int32
+    n_killed: jnp.ndarray  # int32
+
+
+def merge_rows(state: BinnedStore, sl: RowSlice) -> MergeRowsResult:
+    """Row-granular anti-entropy merge: gather the slice's rows whole,
+    do every step as dense row-local vector math, scatter the rows back
+    (U row indices — row scatters are index-cheap, unlike the element
+    scatters the first merge design compacted and budgeted around).
+
+    Consequences of working on whole rows:
+
+    - the kill pass runs on EVERY synced row for free — no ``amin``
+      pruning, no ``kill_budget`` tier, no retry recompiles;
+    - inserts need no position bookkeeping or sort-compaction tier:
+      survivors and inserts pack together with one in-row sort, which
+      also reclaims every hole (merges never fragment rows);
+    - the only capacity escape left is genuine bin overflow
+      (``need_fill_grow``: alive survivors + inserts > B) plus the gid
+      table and interval-gap conditions.
+
+    Semantics are identical to the reference join (``aw_lww_map.ex:
+    153-209``): insert s2 ∖ c1, kill s1 dots covered by the remote
+    interval and absent from s2, context union = per-(bucket, writer)
+    max, delta-interval contiguity enforced (``need_ctx_gap``).
+    """
+    L = state.num_buckets
+    B = state.bin_capacity
+    R = state.replica_capacity
+    u, s = sl.key.shape
+
+    valid = sl.rows >= 0
+    rows_safe = jnp.where(valid, sl.rows, L)
+    rows_clip = jnp.clip(rows_safe, 0, L - 1)
+
+    gids = merge_gid_tables(state.ctx_gid, sl.ctx_gid)
+
+    # remote context intervals in local slot indexing: [U, R]
+    uu_r = jnp.broadcast_to(jnp.arange(u)[:, None], sl.ctx_rows.shape)
+    remap_cols = jnp.broadcast_to(gids.remap[None, :], sl.ctx_rows.shape)
+    rcols = jnp.where(remap_cols >= 0, remap_cols, R)
+    nonempty = sl.ctx_rows > sl.ctx_lo
+    rdense = (
+        jnp.zeros((u, R), jnp.uint32)
+        .at[uu_r, rcols]
+        .max(jnp.where(nonempty, sl.ctx_rows, jnp.uint32(0)), mode="drop")
+    )
+    ldense = (
+        jnp.full((u, R), U32_MAX, jnp.uint32)
+        .at[uu_r, rcols]
+        .min(jnp.where(nonempty, sl.ctx_lo, U32_MAX), mode="drop")
+    )
+    ldense = jnp.where(ldense == U32_MAX, jnp.uint32(0), ldense)
+
+    ln = gids.remap[jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1)]  # [U, S]
+    ln_clip = jnp.clip(ln, 0, R - 1)
+    local_ctx = state.ctx_max[rows_clip]  # [U, R]
+    covered_local = (
+        jnp.take_along_axis(local_ctx, ln_clip.astype(jnp.int32), axis=1) >= sl.ctr
+    )
+    ins = sl.alive & valid[:, None] & ~covered_local & (ln >= 0)
+    need_ctx_gap = jnp.any(
+        valid[:, None] & (rdense > ldense) & (local_ctx < ldense)
+    )
+
+    g = _gather_rows(state, rows_clip)
+    galive = state.alive[rows_clip] & valid[:, None]
+
+    # kill pass ((s1∩s2) ∪ (s1∖c2)) on every row: a local dot dies iff
+    # the interval covers it and the slice doesn't carry it
+    cov_hi = jnp.take_along_axis(rdense, g["node"], axis=1)
+    cov_lo = jnp.take_along_axis(ldense, g["node"], axis=1)
+    covered = (cov_hi >= g["ctr"]) & (cov_lo < g["ctr"])
+    r_ok = sl.alive & (ln >= 0)
+    present = jnp.any(
+        (g["node"][:, :, None] == ln_clip[:, None, :])
+        & (g["ctr"][:, :, None] == sl.ctr[:, None, :])
+        & r_ok[:, None, :],
+        axis=2,
+    )
+    die = galive & covered & ~present
+    alive_surv = galive & ~die
+
+    # pack survivors + inserts into the row's B slots (one stable sort,
+    # holes reclaimed as a side effect)
+    eh_ins = entry_hash(
+        sl.key,
+        sl.ctx_gid[jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1)],
+        sl.ctr,
+        sl.ts,
+        sl.valh,
+    )
+    wide = {
+        "key": jnp.concatenate([g["key"], sl.key], axis=1),
+        "valh": jnp.concatenate([g["valh"], sl.valh], axis=1),
+        "ts": jnp.concatenate([g["ts"], sl.ts], axis=1),
+        "node": jnp.concatenate([g["node"], ln_clip.astype(jnp.int32)], axis=1),
+        "ctr": jnp.concatenate([g["ctr"], sl.ctr], axis=1),
+        "ehash": jnp.concatenate([g["ehash"], eh_ins], axis=1),
+    }
+    w_alive = jnp.concatenate([alive_surv, ins], axis=1)
+    packed_w, alive_w, n_alive_row = _row_compact(wide, w_alive)
+    packed = {c: v[:, :B] for c, v in packed_w.items()}
+    alive_p = alive_w[:, :B]
+    need_fill_grow = jnp.any(valid & (n_alive_row > B))
+    fill_rows = jnp.minimum(n_alive_row, B)
+
+    amin_rows = _row_amin(packed["node"], packed["ctr"], alive_p, u, R)
+    amax_rows = _row_amax(packed["node"], packed["ctr"], alive_p, u, R)
+    leaf_rows = jnp.sum(
+        jnp.where(alive_p, packed["ehash"], jnp.uint32(0)), axis=1, dtype=jnp.uint32
+    )
+    ctx2 = jnp.maximum(local_ctx, rdense)
+
+    new_state = BinnedStore(
+        **{c: getattr(state, c).at[rows_safe].set(packed[c], mode="drop") for c in _ROW_COLS},
+        alive=state.alive.at[rows_safe].set(alive_p, mode="drop"),
+        fill=state.fill.at[rows_safe].set(fill_rows, mode="drop"),
+        amin=state.amin.at[rows_safe].set(amin_rows, mode="drop"),
+        amax=state.amax.at[rows_safe].set(amax_rows, mode="drop"),
+        leaf=state.leaf.at[rows_safe].set(leaf_rows, mode="drop"),
+        ctx_gid=gids.ctx_gid,
+        ctx_max=state.ctx_max.at[rows_safe].set(ctx2, mode="drop"),
+    )
+    ok = ~(gids.overflow | need_fill_grow | need_ctx_gap)
+    return MergeRowsResult(
+        new_state,
+        ok,
+        gids.overflow,
+        need_fill_grow,
+        need_ctx_gap,
+        jnp.sum(ins.astype(jnp.int32)),
+        jnp.sum(die.astype(jnp.int32)),
+    )
+
+
 # ---------------------------------------------------------------------------
 # reads
 

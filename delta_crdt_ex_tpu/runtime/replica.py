@@ -893,7 +893,7 @@ class Replica:
             self._key_terms[key_hash64(key_term)] = key_term
 
         try:
-            res = self._merge_with_growth(sl, n_alive=int(np.sum(a["alive"])))
+            res = self._merge_with_growth(sl)
         except CtxGapError:
             # a delta-interval push is not contiguous with our context (an
             # earlier push was lost): ask the sender for the full rows —
@@ -937,17 +937,13 @@ class Replica:
         )
         self._persist()
 
-    #: initial kill-budget tier for merges (rows the amin test flags as
-    #: possibly containing kills; most sync rounds flag none or few)
-    KILL_BUDGET = 16
-
-    def _merge_with_growth(self, sl, n_alive: int | None = None):
-        self.state, res = self.model.merge_into(
-            self.state,
-            sl,
-            kill_budget=self.KILL_BUDGET,
-            on_grow=self._grown_telemetry,
-            n_alive=n_alive,
+    def _merge_with_growth(self, sl):
+        # row-granular merge: runtime slices are ≤ max_sync_size rows,
+        # where whole-row math costs the same as element scatters but
+        # needs no kill-budget or insert tiers (fewer recompiles; the
+        # only escapes left are genuine bin/gid growth)
+        self.state, res = self.model.merge_rows_into(
+            self.state, sl, on_grow=self._grown_telemetry
         )
         return res
 
