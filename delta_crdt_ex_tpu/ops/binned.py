@@ -36,7 +36,7 @@ remove, per-key LWW by (ts, writer gid, ctr), causal join
 from __future__ import annotations
 
 import dataclasses
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -369,6 +369,74 @@ def extract_own_delta(
     )
 
 
+class SliceView(NamedTuple):
+    """The interval/insert preamble shared by both merge kernels — one
+    implementation so the subtle invariants (empty-interval masking,
+    the U32_MAX ldense sentinel, contiguity) cannot drift between them."""
+
+    valid: jnp.ndarray  # bool[U]
+    rows_safe: jnp.ndarray  # int32[U] (L where padding — scatters drop)
+    rows_clip: jnp.ndarray  # int32[U]
+    gids: Any
+    rdense: jnp.ndarray  # uint32[U, R] interval upper bounds, local slots
+    ldense: jnp.ndarray  # uint32[U, R] interval lower bounds, local slots
+    ln: jnp.ndarray  # int32[U, S] remapped writer slots (-1 unknown)
+    ln_clip: jnp.ndarray  # int32[U, S]
+    local_ctx: jnp.ndarray  # uint32[U, R]
+    ins: jnp.ndarray  # bool[U, S]: slice entries to insert (s2 ∖ c1)
+    need_ctx_gap: jnp.ndarray  # bool
+    nonempty: jnp.ndarray  # bool[U, Rr]: interval columns claiming anything
+
+
+def _slice_view(state: BinnedStore, sl: RowSlice) -> SliceView:
+    L = state.num_buckets
+    R = state.replica_capacity
+    u, s = sl.key.shape
+
+    valid = sl.rows >= 0
+    rows_safe = jnp.where(valid, sl.rows, L)
+    rows_clip = jnp.clip(rows_safe, 0, L - 1)
+
+    gids = merge_gid_tables(state.ctx_gid, sl.ctx_gid)
+
+    # remote context rows in local slot indexing: [U, R]
+    uu_r = jnp.broadcast_to(jnp.arange(u)[:, None], sl.ctx_rows.shape)
+    remap_cols = jnp.broadcast_to(gids.remap[None, :], sl.ctx_rows.shape)
+    rcols = jnp.where(remap_cols >= 0, remap_cols, R)
+    # empty intervals (lo == hi) claim nothing: mask them out of BOTH
+    # bounds, or an idle writer's row would read as a (0, hi] state-form
+    # claim and kill dots the slice never shipped
+    nonempty = sl.ctx_rows > sl.ctx_lo
+    rdense = (
+        jnp.zeros((u, R), jnp.uint32)
+        .at[uu_r, rcols]
+        .max(jnp.where(nonempty, sl.ctx_rows, jnp.uint32(0)), mode="drop")
+    )
+    # interval lower bounds in local slots (0 where nothing shipped)
+    ldense = (
+        jnp.full((u, R), U32_MAX, jnp.uint32)
+        .at[uu_r, rcols]
+        .min(jnp.where(nonempty, sl.ctx_lo, U32_MAX), mode="drop")
+    )
+    ldense = jnp.where(ldense == U32_MAX, jnp.uint32(0), ldense)
+
+    # insert pass (s2 ∖ c1)
+    ln = gids.remap[jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1)]  # [U, S]
+    ln_clip = jnp.clip(ln, 0, R - 1)
+    local_ctx = state.ctx_max[rows_clip]  # [U, R]
+    covered_local = (
+        jnp.take_along_axis(local_ctx, ln_clip.astype(jnp.int32), axis=1) >= sl.ctr
+    )
+    ins = sl.alive & valid[:, None] & ~covered_local & (ln >= 0)
+    # delta-interval contiguity: advancing ctx to hi is only sound if our
+    # context already reaches lo (no unobserved gap beneath the interval)
+    need_ctx_gap = jnp.any(valid[:, None] & (rdense > ldense) & (local_ctx < ldense))
+    return SliceView(
+        valid, rows_safe, rows_clip, gids, rdense, ldense, ln, ln_clip,
+        local_ctx, ins, need_ctx_gap, nonempty,
+    )
+
+
 class MergeResult(NamedTuple):
     state: BinnedStore
     ok: jnp.ndarray  # bool: result valid (budgets sufficed)
@@ -415,52 +483,16 @@ def merge_slice(
     R = state.replica_capacity
     u, s = sl.key.shape
 
-    valid = sl.rows >= 0
-    rows_safe = jnp.where(valid, sl.rows, L)
-    rows_clip = jnp.clip(rows_safe, 0, L - 1)
+    v = _slice_view(state, sl)
+    valid, rows_safe, rows_clip = v.valid, v.rows_safe, v.rows_clip
+    gids, rdense, ldense = v.gids, v.rdense, v.ldense
+    ln, ln_clip, ins, need_ctx_gap = v.ln, v.ln_clip, v.ins, v.need_ctx_gap
 
-    gids = merge_gid_tables(state.ctx_gid, sl.ctx_gid)
-
-    # remote context rows in local slot indexing: [U, R]
-    uu_r = jnp.broadcast_to(jnp.arange(u)[:, None], sl.ctx_rows.shape)
-    remap_cols = jnp.broadcast_to(gids.remap[None, :], sl.ctx_rows.shape)
-    rcols = jnp.where(remap_cols >= 0, remap_cols, R)
-    # empty intervals (lo == hi) claim nothing: mask them out of BOTH
-    # bounds, or an idle writer's row would read as a (0, hi] state-form
-    # claim and kill dots the slice never shipped
-    nonempty = sl.ctx_rows > sl.ctx_lo
-    rdense = (
-        jnp.zeros((u, R), jnp.uint32)
-        .at[uu_r, rcols]
-        .max(jnp.where(nonempty, sl.ctx_rows, jnp.uint32(0)), mode="drop")
-    )
-    # interval lower bounds in local slots (0 where nothing shipped)
-    ldense = (
-        jnp.full((u, R), U32_MAX, jnp.uint32)
-        .at[uu_r, rcols]
-        .min(jnp.where(nonempty, sl.ctx_lo, U32_MAX), mode="drop")
-    )
-    ldense = jnp.where(ldense == U32_MAX, jnp.uint32(0), ldense)
-
-    # --- insert pass (s2 ∖ c1) -------------------------------------------
-    ln = gids.remap[jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1)]  # [U, S]
-    ln_clip = jnp.clip(ln, 0, R - 1)
-    local_ctx_rows = state.ctx_max[rows_clip]  # [U, R]
-    covered_local = (
-        jnp.take_along_axis(local_ctx_rows, ln_clip.astype(jnp.int32), axis=1)
-        >= sl.ctr
-    )
-    ins = sl.alive & valid[:, None] & ~covered_local & (ln >= 0)
-
+    # --- insert pass (s2 ∖ c1): element scatters at fill positions -------
     ins_rank = jnp.cumsum(ins.astype(jnp.int32), axis=1) - 1
     n_ins_row = jnp.sum(ins, axis=1, dtype=jnp.int32)
     fill_rows = state.fill[rows_clip]
     need_fill_compact = jnp.any(valid & (fill_rows + n_ins_row > B))
-    # delta-interval contiguity: advancing ctx to hi is only sound if our
-    # context already reaches lo (no unobserved gap beneath the interval)
-    need_ctx_gap = jnp.any(
-        valid[:, None] & (rdense > ldense) & (local_ctx_rows < ldense)
-    )
     pos = fill_rows[:, None] + ins_rank  # [U, S] target bin slot
 
     # overflowing rows (pos >= B) must not clip into valid slots — drop.
@@ -532,7 +564,7 @@ def merge_slice(
     ctx2 = state.ctx_max
     for rr in range(sl.ctx_gid.shape[0]):
         colr = jnp.where(gids.remap[rr] >= 0, gids.remap[rr], R)
-        vals_r = jnp.where(nonempty[:, rr], sl.ctx_rows[:, rr], jnp.uint32(0))
+        vals_r = jnp.where(v.nonempty[:, rr], sl.ctx_rows[:, rr], jnp.uint32(0))
         ctx2 = ctx2.at[rows_safe, colr].max(vals_r, mode="drop")
 
     # --- kill pass ((s1∩s2) ∪ (s1∖c2)), pruned by amin/amax ---------------
@@ -651,44 +683,15 @@ def merge_rows(state: BinnedStore, sl: RowSlice) -> MergeRowsResult:
     interval and absent from s2, context union = per-(bucket, writer)
     max, delta-interval contiguity enforced (``need_ctx_gap``).
     """
-    L = state.num_buckets
     B = state.bin_capacity
     R = state.replica_capacity
     u, s = sl.key.shape
 
-    valid = sl.rows >= 0
-    rows_safe = jnp.where(valid, sl.rows, L)
-    rows_clip = jnp.clip(rows_safe, 0, L - 1)
-
-    gids = merge_gid_tables(state.ctx_gid, sl.ctx_gid)
-
-    # remote context intervals in local slot indexing: [U, R]
-    uu_r = jnp.broadcast_to(jnp.arange(u)[:, None], sl.ctx_rows.shape)
-    remap_cols = jnp.broadcast_to(gids.remap[None, :], sl.ctx_rows.shape)
-    rcols = jnp.where(remap_cols >= 0, remap_cols, R)
-    nonempty = sl.ctx_rows > sl.ctx_lo
-    rdense = (
-        jnp.zeros((u, R), jnp.uint32)
-        .at[uu_r, rcols]
-        .max(jnp.where(nonempty, sl.ctx_rows, jnp.uint32(0)), mode="drop")
-    )
-    ldense = (
-        jnp.full((u, R), U32_MAX, jnp.uint32)
-        .at[uu_r, rcols]
-        .min(jnp.where(nonempty, sl.ctx_lo, U32_MAX), mode="drop")
-    )
-    ldense = jnp.where(ldense == U32_MAX, jnp.uint32(0), ldense)
-
-    ln = gids.remap[jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1)]  # [U, S]
-    ln_clip = jnp.clip(ln, 0, R - 1)
-    local_ctx = state.ctx_max[rows_clip]  # [U, R]
-    covered_local = (
-        jnp.take_along_axis(local_ctx, ln_clip.astype(jnp.int32), axis=1) >= sl.ctr
-    )
-    ins = sl.alive & valid[:, None] & ~covered_local & (ln >= 0)
-    need_ctx_gap = jnp.any(
-        valid[:, None] & (rdense > ldense) & (local_ctx < ldense)
-    )
+    v = _slice_view(state, sl)
+    valid, rows_safe, rows_clip = v.valid, v.rows_safe, v.rows_clip
+    gids, rdense, ldense = v.gids, v.rdense, v.ldense
+    ln, ln_clip, ins, need_ctx_gap = v.ln, v.ln_clip, v.ins, v.need_ctx_gap
+    local_ctx = v.local_ctx
 
     g = _gather_rows(state, rows_clip)
     galive = state.alive[rows_clip] & valid[:, None]

@@ -24,9 +24,11 @@ from delta_crdt_ex_tpu.models.binned import BinnedStore, pow2_tier
 from delta_crdt_ex_tpu.models.binned_map import tier_retry_merge
 from delta_crdt_ex_tpu.ops.binned import (
     MergeResult,
+    MergeRowsResult,
     RowSlice,
     compact_rows,
     extract_rows,
+    merge_rows,
     merge_slice,
 )
 
@@ -91,12 +93,12 @@ def fanout_merge_into(
     )
 
 
-@partial(jax.jit, static_argnames=("kill_budget",))
-def ring_gossip_round(stacked: BinnedStore, kill_budget: int = 64) -> MergeResult:
+@jax.jit
+def ring_gossip_round(stacked: BinnedStore) -> MergeRowsResult:
     """One full-state gossip round among N chip-resident replicas: replica
-    i merges replica (i-1) mod N's full-row slice. One device call, N
-    merges."""
+    i merges replica (i-1) mod N's full-row slice (row-granular merge —
+    no kill/insert tiers). One device call, N merges."""
     rolled = jax.tree_util.tree_map(lambda x: jnp.roll(x, 1, axis=0), stacked)
     all_rows = jnp.arange(stacked.num_buckets, dtype=jnp.int32)
     slices = jax.vmap(extract_rows, in_axes=(0, None))(rolled, all_rows)
-    return jax.vmap(merge_slice, in_axes=(0, 0, None))(stacked, slices, kill_budget)
+    return jax.vmap(merge_rows)(stacked, slices)

@@ -34,9 +34,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from delta_crdt_ex_tpu.models.binned import BinnedStore
 from delta_crdt_ex_tpu.ops.binned import (
-    compact_rows,
     extract_rows,
-    merge_slice,
+    merge_rows,
     row_apply,
     tree_from_leaves,
 )
@@ -70,7 +69,7 @@ def _unsqueeze(tree):
     return jax.tree_util.tree_map(lambda x: x[None], tree)
 
 
-@partial(jax.jit, static_argnames=("mesh", "kill_budget", "frontier"))
+@partial(jax.jit, static_argnames=("mesh", "frontier"))
 def gossip_delta_step(
     mesh: Mesh,
     stacked: BinnedStore,
@@ -80,7 +79,6 @@ def gossip_delta_step(
     key: jnp.ndarray,  # uint64[N, U, M]
     valh: jnp.ndarray,  # uint32[N, U, M]
     ts: jnp.ndarray,  # int64[N, U, M]
-    kill_budget: int = 64,
     frontier: int = 64,
 ):
     """One bounded-divergence SPMD gossip step — ICI bytes ∝ divergence.
@@ -115,8 +113,8 @@ def gossip_delta_step(
     means replica i's step is invalid and the host must grow that tier
     and replay from the pre-step state (growth cannot happen inside the
     SPMD program; :func:`gossip_delta_drive` is that recovery loop).
-    ``flags[i] = [apply_fill, gid_grow, kill_tier, merge_fill]`` names
-    the offending tier.
+    ``flags[i] = [apply_fill, gid_grow, merge_fill]`` names the
+    offending tier (the row-granular merge has no kill or insert tiers).
     """
     n = mesh.devices.size
     fwd = [(i, (i + 1) % n) for i in range(n)]
@@ -145,13 +143,10 @@ def gossip_delta_step(
         sl = jax.tree_util.tree_map(
             lambda x: jax.lax.ppermute(x, AXIS, fwd), sl_local
         )
-        res = merge_slice(st, sl, kill_budget)
+        res = merge_rows(st, sl)
         root = tree_from_leaves(res.state.leaf)[0][0]
         ok = applied.ok & res.ok
-        flags = jnp.stack(
-            [~applied.ok, res.need_gid_grow, res.need_kill_tier,
-             res.need_fill_compact]
-        )
+        flags = jnp.stack([~applied.ok, res.need_gid_grow, res.need_fill_grow])
         return _unsqueeze(res.state), root[None], ok[None], n_diff[None], flags[None]
 
     return shard_map(
@@ -163,9 +158,6 @@ def gossip_delta_step(
     )(stacked, self_slot, rows, op, key, valh, ts)
 
 
-jit_mesh_compact = jax.jit(jax.vmap(compact_rows))
-
-
 def gossip_delta_drive(
     mesh: Mesh,
     stacked: BinnedStore,
@@ -175,51 +167,45 @@ def gossip_delta_drive(
     key: jnp.ndarray,
     valh: jnp.ndarray,
     ts: jnp.ndarray,
-    kill_budget: int = 64,
     frontier: int = 64,
     on_grow=None,
 ):
     """Host recovery loop around :func:`gossip_delta_step`: a failed step
     (any ``ok=False``) discards that step's states, grows the offending
     tier on the PRE-step states, and replays — mutation batches re-apply
-    idempotently because the failed result was never kept. Tier policy
-    matches :func:`~delta_crdt_ex_tpu.models.binned_map.tier_retry_merge`
-    (bin ×2 after one compact, gid ×2, kill budget ×4 up to L); each
-    retier recompiles the step for the new shapes.
+    idempotently because the failed result was never kept. Growth policy:
+    gid table ×2, bin capacity ×2 after one compact; each retier
+    recompiles the step for the new shapes.
 
     Returns ``(stacked, roots, n_diff, n_retiers)``.
     """
     import numpy as np
 
-    compacted = False
     retiers = 0
     while True:
         out, roots, oks, n_diff, flags = gossip_delta_step(
             mesh, stacked, self_slot, rows, op, key, valh, ts,
-            kill_budget=kill_budget, frontier=frontier,
+            frontier=frontier,
         )
         if bool(np.asarray(oks).all()):
             return out, roots, n_diff, retiers
         retiers += 1
-        f = np.asarray(flags).any(axis=0)  # [4] any replica
-        apply_fill, gid_grow, kill_tier, merge_fill = map(bool, f)
+        f = np.asarray(flags).any(axis=0)  # [3] any replica
+        apply_fill, gid_grow, merge_fill = map(bool, f)
         if gid_grow:
             stacked = stacked.grow(replica_capacity=stacked.replica_capacity * 2)
             if on_grow:
                 on_grow(stacked)
-        if kill_tier:
-            kill_budget = min(kill_budget * 4, stacked.num_buckets)
         if apply_fill or merge_fill:
-            if not compacted:
-                stacked = jit_mesh_compact(stacked)
-                compacted = True
-            else:
-                stacked = stacked.grow(bin_capacity=stacked.bin_capacity * 2)
-                if on_grow:
-                    on_grow(stacked)
+            # no compact-first step: the row-granular merge reclaims holes
+            # in-row, and row_apply counts dead slots as free — a fill
+            # overflow is genuine, so go straight to growth
+            stacked = stacked.grow(bin_capacity=stacked.bin_capacity * 2)
+            if on_grow:
+                on_grow(stacked)
 
 
-@partial(jax.jit, static_argnames=("mesh", "kill_budget"))
+@partial(jax.jit, static_argnames=("mesh",))
 def gossip_train_step(
     mesh: Mesh,
     stacked: BinnedStore,
@@ -229,7 +215,6 @@ def gossip_train_step(
     key: jnp.ndarray,  # uint64[N, U, M]
     valh: jnp.ndarray,  # uint32[N, U, M]
     ts: jnp.ndarray,  # int64[N, U, M]
-    kill_budget: int = 64,
 ):
     """One SPMD step: local mutation batch → ring ppermute → merge → roots.
 
@@ -256,7 +241,7 @@ def gossip_train_step(
         )
         all_rows = jnp.arange(applied.state.num_buckets, dtype=jnp.int32)
         sl = extract_rows(received, all_rows)
-        res = merge_slice(applied.state, sl, kill_budget)
+        res = merge_rows(applied.state, sl)
         root = tree_from_leaves(res.state.leaf)[0][0]
         # ok folds the mutation batch's bin-capacity flag too: a dropped
         # insert (scatter mode='drop') must be as loud as a merge overflow
