@@ -51,8 +51,9 @@ DELTA = 128 if SMOKE else 512  # the merge unit: one 512-entry delta slice
 #: delta slices joined into one group before merging (lattice
 #: associativity: merging the group == merging its slices in order; the
 #: python baseline merges identical groups, so the ratio is unaffected).
-#: This amortises the backend's copy-on-update of the state arrays — see
-#: the Pallas in-place path for the real fix.
+#: This amortises fixed per-call dispatch. Buffer donation already keeps
+#: the merge O(slice) — 16× the capacity costs 1.11× per call
+#: (BASELINE.md "O(slice) merge evidence").
 GROUP = 4 if SMOKE else 16
 CALLS = 2 if SMOKE else 6  # timed calls
 WARMUP_CALLS = 1

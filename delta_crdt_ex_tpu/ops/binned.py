@@ -13,11 +13,15 @@ Kernels:
 - :func:`row_apply` — local mutation batch, grouped by bucket row
   (sequential batch semantics; the reference applies one op per mailbox
   message, ``causal_crdt.ex:337-342``).
-- :func:`merge_slice` — the anti-entropy merge: join a received bucket
-  slice (entries + context rows of exactly the synced buckets). Insert
-  work is O(slice); the kill pass runs only on rows flagged by the
-  ``amin`` pruning test, within a static budget ``KB`` (exceeding it
-  returns ``ok=False`` and the host retries a larger tier).
+- :func:`merge_rows` / :func:`merge_slice` — two anti-entropy merge
+  kernels implementing the SAME join (parity-tested bit-for-bit) under
+  different cost models. ``merge_rows`` (runtime path): whole-row dense
+  math, kill pass on every row, holes reclaimed in-row, no kill/insert
+  tiers — best for ≤ ``max_sync_size``-row slices. ``merge_slice``
+  (bulk fan-in path): element scatters at ``fill`` positions with an
+  ``amin``-pruned kill pass under a ``kill_budget`` tier — cost ∝ slice
+  *entries*, best for sparse many-row slices (the bench's 8192-row
+  delta groups). Shared preamble: :func:`_slice_view`.
 - :func:`winners_for_keys` / :func:`winner_rows` — LWW read resolution
   (``AWLWWMap.read``, ``aw_lww_map.ex:211-224``).
 - :func:`extract_rows` — the sync data plane: gather rows + context rows

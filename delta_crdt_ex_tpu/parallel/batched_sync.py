@@ -8,8 +8,12 @@ once — the BASELINE north-star's 64-neighbour fan-in. The same shape also
 batches a whole gossip round among N chip-resident replicas (each merges
 its ring predecessor's full-row slice) in one call.
 
-All kernels are the row-local binned ops (O(slice) per neighbour, not
-O(capacity) — :mod:`delta_crdt_ex_tpu.ops.binned`).
+Cost models per path: ``fanout_merge`` uses the element-scatter merge
+(O(slice entries) per neighbour — the bench's sparse 8192-row delta
+groups); ``ring_gossip_round`` merges FULL states (O(L·B) per replica
+per round — the simple whole-state flavour; the bounded-divergence
+``gossip_delta_step`` in :mod:`.mesh_gossip` is the O(divergence)
+alternative).
 """
 
 from __future__ import annotations

@@ -255,3 +255,40 @@ def gossip_train_step(
         out_specs=(spec, spec, spec),
         check_vma=False,
     )(stacked, self_slot, rows, op, key, valh, ts)
+
+
+def snapshot_mesh(stacked: BinnedStore) -> dict:
+    """Device→host image of a mesh-stacked replica set (the SPMD analog
+    of the replica Storage snapshot, SURVEY §5.4): one gathered pytree of
+    numpy arrays plus the engine layout tag, suitable for pickling. The
+    gather crosses the ICI/host boundary once per column."""
+    import dataclasses as _dc
+
+    import numpy as np
+
+    from delta_crdt_ex_tpu.runtime.storage import CURRENT_LAYOUT
+
+    return {
+        "layout": CURRENT_LAYOUT,
+        "arrays": {
+            f.name: np.asarray(getattr(stacked, f.name))
+            for f in _dc.fields(BinnedStore)
+        },
+    }
+
+
+def restore_mesh(snap: dict, mesh: Mesh) -> BinnedStore:
+    """Re-place a :func:`snapshot_mesh` image onto a mesh (same replica
+    count; the device set may differ — elasticity across restarts)."""
+    from delta_crdt_ex_tpu.runtime.storage import require_layout
+
+    require_layout(snap.get("layout", "<untagged>"), "mesh snapshot")
+    arrays = snap["arrays"]
+    n = arrays["key"].shape[0]
+    if mesh.devices.size != n:
+        raise ValueError(
+            f"snapshot holds {n} replicas but the mesh has "
+            f"{mesh.devices.size} devices"
+        )
+    stacked = BinnedStore(**{k: jnp.asarray(v) for k, v in arrays.items()})
+    return jax.device_put(stacked, replica_sharding(mesh))

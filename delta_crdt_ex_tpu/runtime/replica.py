@@ -48,7 +48,7 @@ from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap, CtxGapError
 from delta_crdt_ex_tpu.ops.apply import OP_ADD, OP_CLEAR, OP_PAD, OP_REMOVE
 from delta_crdt_ex_tpu.runtime import sync as sync_proto, telemetry, tracing
 from delta_crdt_ex_tpu.runtime.clock import Clock
-from delta_crdt_ex_tpu.runtime.storage import CURRENT_LAYOUT, Snapshot, Storage
+from delta_crdt_ex_tpu.runtime.storage import Snapshot, Storage, require_layout
 from delta_crdt_ex_tpu.runtime.transport import Down, LocalTransport, default_transport
 
 logger = logging.getLogger("delta_crdt_ex_tpu")
@@ -189,13 +189,9 @@ class Replica:
         # NB: __dict__.get, not getattr — a legacy pickle missing the field
         # would otherwise read the dataclass *default* (== CURRENT_LAYOUT)
         # and sail past the guard into an opaque KeyError below
-        layout = snap.__dict__.get("layout", "<untagged>")
-        if layout != CURRENT_LAYOUT:
-            raise ValueError(
-                f"snapshot for {self.name!r} was written by engine layout "
-                f"{layout!r}; this build reads {CURRENT_LAYOUT!r} — "
-                "migrate or delete the stored snapshot to start fresh"
-            )
+        require_layout(
+            snap.__dict__.get("layout", "<untagged>"), f"snapshot for {self.name!r}"
+        )
         self.node_id = snap.node_id
         self._seq = snap.sequence_number
         self.state = BinnedStore(**{c: jnp.asarray(snap.arrays[c]) for c in _COLUMNS})

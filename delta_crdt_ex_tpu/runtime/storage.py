@@ -43,6 +43,20 @@ class Snapshot:
     layout: str = CURRENT_LAYOUT  # engine layout tag (rehydrate checks it)
 
 
+def require_layout(tag, what: str) -> None:
+    """Reject snapshots written by a different engine layout with one
+    shared, descriptive error (used by replica rehydrate AND mesh
+    restore, so the two paths cannot drift). ``tag`` must come from the
+    snapshot's instance data — beware dataclass defaults masking legacy
+    untagged pickles (read ``__dict__``, not ``getattr``)."""
+    if tag != CURRENT_LAYOUT:
+        raise ValueError(
+            f"{what} was written by engine layout {tag!r}; this build "
+            f"reads {CURRENT_LAYOUT!r} — migrate or delete the stored "
+            "snapshot to start fresh"
+        )
+
+
 class Storage(Protocol):
     def write(self, name: Any, snapshot: Snapshot) -> None: ...
 
