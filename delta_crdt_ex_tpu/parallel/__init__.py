@@ -13,6 +13,8 @@ from delta_crdt_ex_tpu.parallel.mesh_gossip import (
     make_mesh,
     place_states,
     replica_sharding,
+    restore_mesh,
+    snapshot_mesh,
 )
 
 __all__ = [
@@ -25,7 +27,9 @@ __all__ = [
     "make_mesh",
     "place_states",
     "replica_sharding",
+    "restore_mesh",
     "ring_gossip_round",
+    "snapshot_mesh",
     "stack_states",
     "unstack_states",
 ]
