@@ -1,0 +1,127 @@
+"""Multi-controller SPMD gossip: two PROCESSES, four virtual devices
+each, one global 8-device mesh — ``gossip_delta_step``'s ppermutes cross
+the process boundary through jax.distributed's backend (the DCN analog;
+on real hardware the same program rides ICI within a pod and DCN across
+hosts). This is the multi-host validation of SURVEY §5.8: the SPMD data
+plane is not limited to one process's devices.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+WORKER = r"""
+import dataclasses, os, sys
+pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+import jax
+jax.distributed.initialize(coordinator_address=coord, num_processes=nproc, process_id=pid)
+import numpy as np
+import jax.numpy as jnp
+import jax.tree_util as tu
+from jax.experimental import multihost_utils
+
+import delta_crdt_ex_tpu  # enables x64
+from delta_crdt_ex_tpu.models.binned import BinnedStore
+from delta_crdt_ex_tpu.ops.apply import OP_ADD
+from delta_crdt_ex_tpu.parallel.mesh_gossip import (
+    gossip_delta_step, make_mesh, replica_sharding,
+)
+
+n = len(jax.devices())
+assert n == 8, f"expected 8 global devices, got {n}"
+assert len(jax.local_devices()) == 4, "each process contributes 4"
+mesh = make_mesh()
+sharding = replica_sharding(mesh)
+L = 64
+
+# identical host-side construction in every process; each process then
+# contributes only its addressable shards
+states = []
+for i in range(n):
+    st = BinnedStore.new(L, 8, 8)
+    st = dataclasses.replace(st, ctx_gid=st.ctx_gid.at[0].set(jnp.uint64(100 + i)))
+    states.append(st)
+host = tu.tree_map(lambda *xs: np.stack([np.asarray(x) for x in xs]), *states)
+
+def gput(x):
+    return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+
+stacked = tu.tree_map(gput, host)
+self_slot = gput(np.zeros(n, np.int32))
+
+from tests.test_parallel import grouped_mutations
+
+def batches(ops_per_replica):
+    # same wire shapes as the in-process mesh tests; re-place each array
+    # as a global (process-spanning) sharded array
+    return tuple(
+        gput(np.asarray(a)) for a in grouped_mutations(n, L, ops_per_replica)
+    )
+
+seed = batches([[(OP_ADD, 1000 + i, i, i + 1)] for i in range(n)])
+stacked, roots, oks, n_diff, _fl = gossip_delta_step(mesh, stacked, self_slot, *seed)
+empty = batches([[] for _ in range(n)])
+for _ in range(2 * n):
+    stacked, roots, oks, n_diff, _fl = gossip_delta_step(mesh, stacked, self_slot, *empty)
+
+oks_g = multihost_utils.process_allgather(oks, tiled=True)
+roots_g = multihost_utils.process_allgather(roots, tiled=True)
+nd_g = multihost_utils.process_allgather(n_diff, tiled=True)
+assert bool(np.asarray(oks_g).all()), "a replica overflowed a tier"
+assert (np.asarray(roots_g) == np.asarray(roots_g).ravel()[0]).all(), "roots diverged"
+assert int(np.asarray(nd_g).max()) == 0, "divergence left"
+print(f"MULTIHOST_OK pid={pid} roots={np.asarray(roots_g).ravel()[0]}", flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_global_mesh_gossip(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    # substitute only the device-count flag; preserve ambient XLA flags
+    import re
+
+    flag = "xla_force_host_platform_device_count"
+    flags = env.get("XLA_FLAGS", "")
+    if flag in flags:
+        flags = re.sub(rf"--{flag}=\d+", f"--{flag}=4", flags)
+    else:
+        flags = f"{flags} --{flag}=4".strip()
+    env["XLA_FLAGS"] = flags
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid), "2", coord],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True,
+        )
+        for pid in range(2)
+    ]
+    try:
+        deadline = time.monotonic() + 240
+        outs = []
+        for p in procs:
+            remaining = max(5.0, deadline - time.monotonic())
+            out, err = p.communicate(timeout=remaining)
+            outs.append((p.returncode, out, err))
+        for rc, out, err in outs:
+            assert rc == 0 and "MULTIHOST_OK" in out, f"worker failed: {err[-3000:]}"
+        # both controllers computed the same converged digest root
+        roots = {o.split("roots=")[1].split()[0] for _, o, _ in outs}
+        assert len(roots) == 1, roots
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
