@@ -1,0 +1,136 @@
+"""Phase-level timing of the north-star merge on the live device.
+
+Compares ``merge_slice`` vs ``merge_rows`` on the bench workload and
+times isolated pieces (slice-view preamble, insert sort, element
+scatters, kill pass) to attribute the per-call cost.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from delta_crdt_ex_tpu.utils.devices import enable_compilation_cache
+
+enable_compilation_cache()
+
+from delta_crdt_ex_tpu.ops.binned import (
+    _slice_view,
+    entry_hash,
+    merge_rows,
+    merge_slice,
+)
+from delta_crdt_ex_tpu.utils.synth import build_state, interval_delta_stream
+
+N_KEYS = 1_000_000
+TREE_DEPTH = 14
+BIN_CAP = 128
+NEIGHBOURS = 64
+DELTA = 512
+GROUP = 16
+RCAP = 8
+
+log = lambda *a: print(*a, file=sys.stderr, flush=True)
+
+
+def timed(fn, n=6):
+    out = fn()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    L = 1 << TREE_DEPTH
+    rng = np.random.default_rng(0)
+    keys = rng.integers(1, 1 << 63, size=N_KEYS, dtype=np.uint64)
+    log(f"devices: {jax.devices()}")
+
+    one, _ = build_state(11, keys, num_buckets=L, bin_capacity=BIN_CAP,
+                         replica_capacity=RCAP)
+    jax.block_until_ready(one)
+    stacked = jax.tree_util.tree_map(
+        lambda x: jnp.copy(jnp.broadcast_to(x, (NEIGHBOURS,) + x.shape)), one
+    )
+    jax.block_until_ready(stacked)
+
+    slices, _ = interval_delta_stream(22, rng, 1, GROUP * DELTA, L, bin_width=16)
+    sl = slices[0]
+    log(f"slice shape: rows={sl.rows.shape} entries={sl.key.shape}")
+
+    @jax.jit
+    def f_slice(states, s):
+        res = jax.vmap(merge_slice, in_axes=(0, None, None, None))(
+            states, s, 8, GROUP * DELTA
+        )
+        return res.state.leaf, res.ok
+
+    log(f"merge_slice x64: {timed(lambda: f_slice(stacked, sl))*1e3:.1f} ms")
+
+    @jax.jit
+    def f_rows(states, s):
+        res = jax.vmap(merge_rows, in_axes=(0, None))(states, s)
+        return res.state.leaf, res.ok
+
+    log(f"merge_rows  x64: {timed(lambda: f_rows(stacked, sl))*1e3:.1f} ms")
+
+    @jax.jit
+    def f_view(states, s):
+        v = jax.vmap(lambda st: _slice_view(st, s))(states)
+        return v.ins, v.rdense
+
+    log(f"_slice_view x64: {timed(lambda: f_view(stacked, sl))*1e3:.1f} ms")
+
+    # element scatters alone: one column, full 8192-entry compacted scatter
+    u, s_w = sl.key.shape
+    B = BIN_CAP
+
+    @jax.jit
+    def f_scatter(states, s):
+        rows_clip = jnp.clip(s.rows, 0, L - 1)
+        pos = states.fill[:, rows_clip][:, :, None] + jnp.broadcast_to(
+            jnp.arange(s_w, dtype=jnp.int32), (u, s_w)
+        )
+        flat = rows_clip[:, None] * B + jnp.clip(pos, 0, B - 1)  # [N, U, S]
+        def one_col(col, fl):
+            return col.reshape(-1).at[fl.reshape(-1)].set(
+                s.ctr.reshape(-1), mode="drop"
+            )
+        return jax.vmap(one_col)(states.ctr, flat)
+
+    log(f"1-col scatter x64: {timed(lambda: f_scatter(stacked, sl))*1e3:.1f} ms")
+
+    @jax.jit
+    def f_sort(s):
+        return jnp.argsort(
+            jnp.broadcast_to(s.key.reshape(-1), (NEIGHBOURS, u * s_w)), axis=1
+        )
+
+    log(f"argsort 8192 x64: {timed(lambda: f_sort(sl))*1e3:.1f} ms")
+
+    # gather whole rows x64 (merge_rows' main memory traffic)
+    @jax.jit
+    def f_gather(states, s):
+        rows_clip = jnp.clip(s.rows, 0, L - 1)
+        return (
+            states.key[:, rows_clip],
+            states.ts[:, rows_clip],
+            states.alive[:, rows_clip],
+        )
+
+    log(f"row gather x64: {timed(lambda: f_gather(stacked, sl))*1e3:.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

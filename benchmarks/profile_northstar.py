@@ -1,0 +1,120 @@
+"""Breakdown profile of the north-star merge call on the live device.
+
+Times the two halves of ``bench.py``'s ``merge_chunk`` separately —
+the vmapped ``merge_slice`` join and the digest-tree root fold — so
+optimization effort goes where the time is. Run on TPU (no env knobs)
+or CPU (``JAX_PLATFORMS=cpu``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from delta_crdt_ex_tpu.utils.devices import enable_compilation_cache
+
+enable_compilation_cache()
+
+from delta_crdt_ex_tpu.ops.binned import merge_slice, tree_from_leaves
+from delta_crdt_ex_tpu.utils.synth import build_state, interval_delta_stream
+
+N_KEYS = 1_000_000
+TREE_DEPTH = 14
+BIN_CAP = 128
+NEIGHBOURS = 64
+DELTA = 512
+GROUP = 16
+RCAP = 8
+
+log = lambda *a: print(*a, file=sys.stderr, flush=True)
+
+
+def timed(fn, *args, n=6, **kw):
+    out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    L = 1 << TREE_DEPTH
+    rng = np.random.default_rng(0)
+    keys = rng.integers(1, 1 << 63, size=N_KEYS, dtype=np.uint64)
+    log(f"devices: {jax.devices()}")
+
+    one, _ = build_state(11, keys, num_buckets=L, bin_capacity=BIN_CAP,
+                         replica_capacity=RCAP)
+    jax.block_until_ready(one)
+    stacked = jax.tree_util.tree_map(
+        lambda x: jnp.copy(jnp.broadcast_to(x, (NEIGHBOURS,) + x.shape)), one
+    )
+    jax.block_until_ready(stacked)
+
+    slices, _ = interval_delta_stream(22, rng, 1, GROUP * DELTA, L, bin_width=16)
+    sl = slices[0]
+
+    # --- merge only (donated, like the bench) ---
+    @jax.jit
+    def merge_only(states, s):
+        res = jax.vmap(merge_slice, in_axes=(0, None, None, None))(
+            states, s, 8, GROUP * DELTA
+        )
+        return res.state, res.ok
+
+    # non-donated so we can re-run on identical input
+    t_merge = timed(lambda: merge_only(stacked, sl))
+    log(f"merge_slice x{NEIGHBOURS} (no donation): {t_merge*1e3:.1f} ms/call")
+
+    # --- roots only ---
+    leaf = stacked.leaf
+
+    @jax.jit
+    def roots_xla(lf):
+        return jax.vmap(lambda x: tree_from_leaves(x)[0][0])(lf)
+
+    t_roots = timed(lambda: roots_xla(leaf))
+    log(f"tree roots XLA x{NEIGHBOURS}: {t_roots*1e3:.1f} ms/call")
+
+    # --- single-neighbour merge (dispatch floor) ---
+    one_state = jax.tree_util.tree_map(lambda x: x[0], stacked)
+
+    @jax.jit
+    def merge_one(state, s):
+        res = merge_slice(state, s, 8, GROUP * DELTA)
+        return res.state, res.ok
+
+    t_one = timed(lambda: merge_one(one_state, sl))
+    log(f"merge_slice x1: {t_one*1e3:.1f} ms/call")
+
+    # --- GROUP=1-sized slice, 64 neighbours (per-merge dispatch cost) ---
+    slices1, _ = interval_delta_stream(22, rng, 1, DELTA, L, bin_width=16)
+
+    @jax.jit
+    def merge_small(states, s):
+        res = jax.vmap(merge_slice, in_axes=(0, None, None, None))(
+            states, s, 8, DELTA
+        )
+        return res.state, res.ok
+
+    t_small = timed(lambda: merge_small(stacked, slices1[0]))
+    log(f"merge_slice x{NEIGHBOURS}, {DELTA}-entry slice: {t_small*1e3:.1f} ms/call")
+
+    log(
+        f"summary: merge {t_merge*1e3:.1f} + roots {t_roots*1e3:.1f} ms; "
+        f"bench-call estimate {(t_merge + t_roots)*1e3:.1f} ms"
+    )
+
+
+if __name__ == "__main__":
+    main()

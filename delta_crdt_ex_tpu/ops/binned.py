@@ -93,6 +93,38 @@ def tree_from_leaves(leaf: jnp.ndarray) -> list[jnp.ndarray]:
     return levels[::-1]
 
 
+#: table sizes up to this use the unrolled select chain; beyond it the
+#: plain gather wins again (select cost scales linearly in table size)
+_LOOKUP_UNROLL_MAX = 32
+
+
+def _table_lookup(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """``table[idx]`` for a tiny 1-D table. TPU gathers cost ~100
+    cycles per element; for an R-sized table an unrolled chain of R
+    vector selects is pure VPU work and an order of magnitude faster on
+    the [U, S] slice grids (``idx`` must already be clipped to range)."""
+    n = table.shape[0]
+    if n > _LOOKUP_UNROLL_MAX:
+        return table[idx]
+    out = jnp.broadcast_to(table[0], idx.shape)
+    for i in range(1, n):
+        out = jnp.where(idx == i, table[i], out)
+    return out
+
+
+def _row_table_lookup(tbl: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """``take_along_axis(tbl, idx, axis=1)`` for a small trailing dim:
+    ``tbl[u, idx[u, s]]`` via R vector selects (same rationale as
+    :func:`_table_lookup`; ``idx`` must be clipped to ``[0, R)``)."""
+    r = tbl.shape[1]
+    if r > _LOOKUP_UNROLL_MAX:
+        return jnp.take_along_axis(tbl, idx, axis=1)
+    out = jnp.broadcast_to(tbl[:, :1], idx.shape)
+    for i in range(1, r):
+        out = jnp.where(idx == i, tbl[:, i : i + 1], out)
+    return out
+
+
 def _row_amin(node, ctr, alive, u, r):
     """uint32[U, R] min alive counter per (row, writer slot)."""
     uu = jnp.broadcast_to(jnp.arange(u)[:, None], node.shape)
@@ -403,34 +435,48 @@ def _slice_view(state: BinnedStore, sl: RowSlice) -> SliceView:
 
     gids = merge_gid_tables(state.ctx_gid, sl.ctx_gid)
 
-    # remote context rows in local slot indexing: [U, R]
-    uu_r = jnp.broadcast_to(jnp.arange(u)[:, None], sl.ctx_rows.shape)
-    remap_cols = jnp.broadcast_to(gids.remap[None, :], sl.ctx_rows.shape)
-    rcols = jnp.where(remap_cols >= 0, remap_cols, R)
     # empty intervals (lo == hi) claim nothing: mask them out of BOTH
     # bounds, or an idle writer's row would read as a (0, hi] state-form
     # claim and kill dots the slice never shipped
     nonempty = sl.ctx_rows > sl.ctx_lo
-    rdense = (
-        jnp.zeros((u, R), jnp.uint32)
-        .at[uu_r, rcols]
-        .max(jnp.where(nonempty, sl.ctx_rows, jnp.uint32(0)), mode="drop")
-    )
+    rr_n = sl.ctx_gid.shape[0]
+    if rr_n * R <= _LOOKUP_UNROLL_MAX * _LOOKUP_UNROLL_MAX:
+        # remote context rows in local slot indexing: [U, R]. The remap
+        # is a per-slice constant [Rr], so the dense forms are a one-hot
+        # max/min over the Rr axis — no scatters (remap < 0 matches no
+        # column, the old mode="drop")
+        oh = gids.remap[:, None] == jnp.arange(R, dtype=jnp.int32)[None, :]
+        sel3 = nonempty[:, :, None] & oh[None]  # [U, Rr, R]
+        rdense = jnp.max(
+            jnp.where(sel3, sl.ctx_rows[:, :, None], jnp.uint32(0)), axis=1
+        )
+        ldense = jnp.min(jnp.where(sel3, sl.ctx_lo[:, :, None], U32_MAX), axis=1)
+    else:
+        # large writer tables: the [U, Rr, R] one-hot intermediates would
+        # dwarf the scatter they replace — keep the scatter form there
+        uu_r = jnp.broadcast_to(jnp.arange(u)[:, None], sl.ctx_rows.shape)
+        remap_cols = jnp.broadcast_to(gids.remap[None, :], sl.ctx_rows.shape)
+        rcols = jnp.where(remap_cols >= 0, remap_cols, R)
+        rdense = (
+            jnp.zeros((u, R), jnp.uint32)
+            .at[uu_r, rcols]
+            .max(jnp.where(nonempty, sl.ctx_rows, jnp.uint32(0)), mode="drop")
+        )
+        ldense = (
+            jnp.full((u, R), U32_MAX, jnp.uint32)
+            .at[uu_r, rcols]
+            .min(jnp.where(nonempty, sl.ctx_lo, U32_MAX), mode="drop")
+        )
     # interval lower bounds in local slots (0 where nothing shipped)
-    ldense = (
-        jnp.full((u, R), U32_MAX, jnp.uint32)
-        .at[uu_r, rcols]
-        .min(jnp.where(nonempty, sl.ctx_lo, U32_MAX), mode="drop")
-    )
     ldense = jnp.where(ldense == U32_MAX, jnp.uint32(0), ldense)
 
     # insert pass (s2 ∖ c1)
-    ln = gids.remap[jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1)]  # [U, S]
+    ln = _table_lookup(
+        gids.remap, jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1)
+    )  # [U, S]
     ln_clip = jnp.clip(ln, 0, R - 1)
     local_ctx = state.ctx_max[rows_clip]  # [U, R]
-    covered_local = (
-        jnp.take_along_axis(local_ctx, ln_clip.astype(jnp.int32), axis=1) >= sl.ctr
-    )
+    covered_local = _row_table_lookup(local_ctx, ln_clip.astype(jnp.int32)) >= sl.ctr
     ins = sl.alive & valid[:, None] & ~covered_local & (ln >= 0)
     # delta-interval contiguity: advancing ctx to hi is only sound if our
     # context already reaches lo (no unobserved gap beneath the interval)
@@ -507,7 +553,9 @@ def merge_slice(
     flat = jnp.where(
         ins & (pos < B), rows_clip[:, None] * B + jnp.clip(pos, 0, B - 1), pad_idx
     )
-    gid_of_entry = sl.ctx_gid[jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1)]
+    gid_of_entry = _table_lookup(
+        sl.ctx_gid, jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1)
+    )
     eh_ins = entry_hash(sl.key, gid_of_entry, sl.ctr, sl.ts, sl.valh)
     n_inserted = jnp.sum(ins.astype(jnp.int32))
 
@@ -702,8 +750,8 @@ def merge_rows(state: BinnedStore, sl: RowSlice) -> MergeRowsResult:
 
     # kill pass ((s1∩s2) ∪ (s1∖c2)) on every row: a local dot dies iff
     # the interval covers it and the slice doesn't carry it
-    cov_hi = jnp.take_along_axis(rdense, g["node"], axis=1)
-    cov_lo = jnp.take_along_axis(ldense, g["node"], axis=1)
+    cov_hi = _row_table_lookup(rdense, g["node"])
+    cov_lo = _row_table_lookup(ldense, g["node"])
     covered = (cov_hi >= g["ctr"]) & (cov_lo < g["ctr"])
     r_ok = sl.alive & (ln >= 0)
     present = jnp.any(
@@ -719,7 +767,7 @@ def merge_rows(state: BinnedStore, sl: RowSlice) -> MergeRowsResult:
     # holes reclaimed as a side effect)
     eh_ins = entry_hash(
         sl.key,
-        sl.ctx_gid[jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1)],
+        _table_lookup(sl.ctx_gid, jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1)),
         sl.ctr,
         sl.ts,
         sl.valh,
@@ -811,7 +859,7 @@ def winners_for_keys(state: BinnedStore, khash: jnp.ndarray) -> KeyWinners:
     g_ts = state.ts[rows]
     g_key = state.key[rows]
     g_alive = state.alive[rows] & (g_key == khash[:, None])
-    g_gid = state.ctx_gid[state.node[rows]]
+    g_gid = _table_lookup(state.ctx_gid, state.node[rows])
     g_ctr = state.ctr[rows]
     best = _argmax_lww(g_ts, g_gid, g_ctr, g_alive)
     take = lambda a: jnp.take_along_axis(a, best, axis=1)[:, 0]
@@ -850,7 +898,7 @@ def winner_rows(state: BinnedStore, rows: jnp.ndarray) -> RowWinners:
     key = state.key[rows_clip]
     ts = state.ts[rows_clip]
     ctr = state.ctr[rows_clip]
-    gid = state.ctx_gid[state.node[rows_clip]]
+    gid = _table_lookup(state.ctx_gid, state.node[rows_clip])
     valh = state.valh[rows_clip]
     alive = state.alive[rows_clip] & valid[:, None]
 
@@ -875,7 +923,7 @@ def init_from_columns(state: BinnedStore) -> BinnedStore:
     (benchmarks, bulk loads): the host fills key/valh/ts/node/ctr/alive
     and the context tables; the device derives the rest in one pass."""
     ehash = entry_hash(
-        state.key, state.ctx_gid[state.node], state.ctr, state.ts, state.valh
+        state.key, _table_lookup(state.ctx_gid, state.node), state.ctr, state.ts, state.valh
     )
     return compact_rows(dataclasses.replace(state, ehash=ehash))
 

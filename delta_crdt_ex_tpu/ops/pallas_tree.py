@@ -1,23 +1,26 @@
-"""Pallas TPU kernel: fused digest-tree build from leaf digests.
+"""Pallas TPU kernels: fused digest-tree folds over leaf digests.
 
-``tree_from_leaves`` (the ``MerkleMap.update_hashes`` analog,
+The digest tree (the ``MerkleMap.update_hashes`` analog,
 ``causal_crdt.ex:254``) folds the maintained leaf digests into parent
 levels. The XLA version materialises every level as a separate HBM
 array with one fusion per level — log2(L) kernel launches and HBM round
 trips. The whole working set is tiny (a replica's leaf array at
-L = 2^14 is 64 KB), so the Pallas kernel keeps the entire fold in VMEM:
-one launch computes all levels of a *batch* of trees (the vmapped
-neighbour axis of the bench) and writes the packed parent levels once.
+L = 2^14 is 64 KB), so a Pallas kernel can keep the entire fold in VMEM.
 
-Layout: parent levels are packed into one ``uint32[N, L]`` output —
-level d (size 2^d, d = depth-1 … 0) lives at offset ``2^d`` … ``2^(d+1)``
-(heap order: node i of level d at index ``2^d + i``; index 1 = root,
-index 0 unused). The level-combine mix matches
-:func:`delta_crdt_ex_tpu.ops.binned.tree_from_leaves` bit for bit, so
-either implementation can serve the sync walk.
+**The production kernel is** :func:`batched_roots_pallas` (what
+:func:`batched_roots_fn` probes and the bench uses): a roll-based
+strided fold over (8, L) blocks that computes one root per tree of a
+batch in a single launch. It is shaped around Mosaic's TPU constraints
+— 8-row blocks, full-width rolls, no reshapes.
 
-Falls back to the XLA path transparently where Pallas TPU lowering is
-unavailable (CPU tests run the interpreter instead).
+:func:`tree_from_leaves_pallas` is the round-1 packed-ALL-levels kernel
+(heap order: node i of level d at index ``2^d + i``; index 1 = root).
+Its (1, L) block spec never lowered on real TPUs (Mosaic requires the
+second-to-last block dim be a multiple of 8); it is kept as an
+interpret-mode executable spec of the packed-levels layout should a
+future sync walk want on-device levels. Both kernels' combine mix
+matches :func:`delta_crdt_ex_tpu.ops.binned.tree_from_leaves` bit for
+bit, so any implementation can serve the sync walk.
 """
 
 from __future__ import annotations
@@ -89,18 +92,72 @@ def unpack_levels(packed: jnp.ndarray, depth: int) -> list[jnp.ndarray]:
     return [packed[(1 << d) : (1 << (d + 1))] for d in range(depth)]
 
 
+def _roots_kernel(leaf_ref, out_ref):
+    """Strided in-place fold: after step s, the value of level-(depth-s)
+    node i sits at lane ``i * 2**s`` (other lanes hold garbage that no
+    later step reads). Every step is a full-width roll + combine — no
+    reshapes or strided slices, which Mosaic's TPU lowering rejects or
+    relayouts; the roll is a native lane rotation. Root lands in lane 0."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cur = leaf_ref[...]  # [NB, L]
+    L = cur.shape[1]
+    k = 1
+    while k < L:
+        # shifted[i] = cur[i + k]  (pltpu.roll wants non-negative shifts)
+        shifted = pltpu.roll(cur, L - k, 1)
+        cur = _combine(cur, shifted)
+        k *= 2
+    out_ref[...] = cur[:, : out_ref.shape[1]]
+
+
+def batched_roots_pallas(leaf: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
+    """Tree roots ``uint32[N]`` for a batch of leaf arrays ``uint32[N, L]``
+    in one fused kernel launch, entire fold in VMEM. The batch is padded
+    to a multiple of 8 (Mosaic requires the second-to-last block dim be a
+    multiple of 8; the round-1 packed-levels kernel used (1, L) blocks and
+    never lowered on real TPUs)."""
+    import jax
+    from jax.experimental import pallas as pl
+
+    n, L = leaf.shape
+    if L < 128 or L & (L - 1):
+        raise ValueError(
+            f"batched_roots_pallas needs a power-of-two L >= 128 (one full "
+            f"lane vector), got L={L}; use the XLA fold for smaller trees"
+        )
+    nb = 8
+    n_pad = -(-n // nb) * nb
+    if n_pad != n:
+        leaf = jnp.concatenate(
+            [leaf, jnp.zeros((n_pad - n, L), jnp.uint32)], axis=0
+        )
+    out = pl.pallas_call(
+        _roots_kernel,
+        out_shape=jax.ShapeDtypeStruct((n_pad, 128), jnp.uint32),
+        grid=(n_pad // nb,),
+        in_specs=[pl.BlockSpec((nb, L), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((nb, 128), lambda i: (i, 0)),
+        interpret=interpret,
+    )(leaf)
+    return out[:n, 0]
+
+
 def batched_roots_fn(num_leaves: int):
     """Probe Pallas availability once and return a jittable
     ``uint32[N, L] -> uint32[N]`` batched-roots function: the fused
-    kernel where it lowers, the per-level XLA fold elsewhere."""
+    roll-fold kernel where it lowers, the per-level XLA fold elsewhere.
+    (L must cover at least one 128-lane vector for the kernel.)"""
     import jax
 
     from delta_crdt_ex_tpu.ops.binned import tree_from_leaves as xla_tree
 
-    try:
-        jax.jit(tree_from_leaves_pallas)(
-            jnp.zeros((2, num_leaves), jnp.uint32)
-        ).block_until_ready()
-        return lambda leaf: tree_from_leaves_pallas(leaf)[:, 1], "pallas"
-    except Exception:
-        return jax.vmap(lambda lf: xla_tree(lf)[0][0]), "xla"
+    if num_leaves >= 128:
+        try:
+            jax.jit(batched_roots_pallas)(
+                jnp.zeros((2, num_leaves), jnp.uint32)
+            ).block_until_ready()
+            return batched_roots_pallas, "pallas"
+        except Exception:
+            pass
+    return jax.vmap(lambda lf: xla_tree(lf)[0][0]), "xla"

@@ -10,6 +10,7 @@ import jax.numpy as jnp
 
 from delta_crdt_ex_tpu.ops.binned import tree_from_leaves
 from delta_crdt_ex_tpu.ops.pallas_tree import (
+    batched_roots_pallas,
     tree_from_leaves_pallas,
     unpack_levels,
 )
@@ -27,6 +28,18 @@ def test_pallas_tree_matches_xla_levels():
         assert len(got) == len(want)
         for lw, lg in zip(want, got):
             assert np.array_equal(np.asarray(lw), np.asarray(lg))
+
+
+def test_pallas_roots_matches_xla():
+    """The roll-fold roots kernel (the one that lowers on real TPUs —
+    8-row blocks, no reshapes) agrees with the XLA fold, including the
+    batch-padding path (N not a multiple of 8)."""
+    rng = np.random.default_rng(1)
+    for n, L in [(3, 256), (8, 512), (11, 128)]:
+        leaves = jnp.asarray(rng.integers(0, 1 << 32, size=(n, L), dtype=np.uint32))
+        got = batched_roots_pallas(leaves, interpret=True)
+        want = [int(tree_from_leaves(leaves[i])[0][0]) for i in range(n)]
+        assert [int(x) for x in got] == want
 
 
 def test_pallas_tree_distinguishes_sibling_order():
