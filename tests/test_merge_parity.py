@@ -78,3 +78,38 @@ def test_interval_stream_and_gap_parity():
     r2 = merge_rows(fresh, slices[1])
     assert bool(r1.need_ctx_gap) and bool(r2.need_ctx_gap)
     assert not bool(r1.ok) and not bool(r2.ok)
+
+
+def test_large_writer_table_fallback_parity():
+    """States whose writer tables exceed the select-unroll threshold
+    compile the gather/scatter fallback branches of ``_slice_view`` and
+    ``_table_lookup``; the merge result must be identical to the small-R
+    one-hot path. Leaf digests and dot sets are slot-independent (entry
+    hashes use global writer ids), so an rcap=8 and an rcap=64 replica
+    fed the same script must agree bit-for-bit on both."""
+    L = 16
+    for trial in range(4):
+        pairs = {}
+        for rcap in (8, 64):
+            a = BinnedKernelMap(gid=100, capacity=128, rcap=rcap, num_buckets=L)
+            b = BinnedKernelMap(gid=200, capacity=128, rcap=rcap, num_buckets=L)
+            script = np.random.default_rng(1000 + trial)
+            for ts in range(1, 20):
+                who = a if script.random() < 0.5 else b
+                k = int(script.integers(0, 24))
+                if script.random() < 0.75:
+                    who.add(k, int(script.integers(0, 100)), ts=ts)
+                else:
+                    who.remove(k, ts=ts)
+            a.join_from(b)  # give kills remote targets
+            sl = extract_rows(b.state, jnp.arange(L, dtype=jnp.int32))
+            r1 = merge_slice(a.state, sl, kill_budget=L, max_inserts=None)
+            r2 = merge_rows(a.state, sl)
+            assert bool(r1.ok) and bool(r2.ok), (trial, rcap)
+            assert_states_equal(r1.state, r2.state, (trial, rcap))
+            pairs[rcap] = r1.state
+        # cross-rcap agreement on every slot-independent view
+        s8, s64 = pairs[8], pairs[64]
+        assert read_binned_state(s8) == read_binned_state(s64), trial
+        assert dots_of(s8) == dots_of(s64), trial
+        assert np.array_equal(np.asarray(s8.leaf), np.asarray(s64.leaf)), trial
