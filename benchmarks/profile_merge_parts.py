@@ -65,7 +65,7 @@ def main():
     )
     jax.block_until_ready(stacked)
 
-    slices, _ = interval_delta_stream(22, rng, 1, GROUP * DELTA, L, bin_width=16)
+    slices, _ = interval_delta_stream(22, rng, 1, GROUP * DELTA, L, bin_width=8)
     sl = slices[0]
     log(f"slice shape: rows={sl.rows.shape} entries={sl.key.shape}")
 

@@ -61,7 +61,7 @@ def main():
     )
     jax.block_until_ready(stacked)
 
-    slices, _ = interval_delta_stream(22, rng, 1, GROUP * DELTA, L, bin_width=16)
+    slices, _ = interval_delta_stream(22, rng, 1, GROUP * DELTA, L, bin_width=8)
     sl = slices[0]
 
     # --- merge only (donated, like the bench) ---
@@ -98,7 +98,7 @@ def main():
     log(f"merge_slice x1: {t_one*1e3:.1f} ms/call")
 
     # --- GROUP=1-sized slice, 64 neighbours (per-merge dispatch cost) ---
-    slices1, _ = interval_delta_stream(22, rng, 1, DELTA, L, bin_width=16)
+    slices1, _ = interval_delta_stream(22, rng, 1, DELTA, L, bin_width=8)
 
     @jax.jit
     def merge_small(states, s):
