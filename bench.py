@@ -309,6 +309,7 @@ def _device_backend_usable(timeout_s: float, attempts: int) -> bool:
 
     if os.environ.get("JAX_PLATFORMS", "") in ("cpu", ""):
         return True
+    retry_sleep = float(os.environ.get("BENCH_CLAIM_RETRY_SLEEP", "120"))
     for attempt in range(attempts):
         try:
             proc = subprocess.run(
@@ -323,6 +324,13 @@ def _device_backend_usable(timeout_s: float, attempts: int) -> bool:
         except subprocess.TimeoutExpired:
             log(f"device claim probe timed out after {timeout_s:.0f}s "
                 f"(attempt {attempt + 1}/{attempts}) — claim may be wedged")
+            continue  # the timeout already consumed the attempt's patience
+        # fast UNAVAILABLE errors would burn all attempts in seconds —
+        # space them out so a recovering claim can still be caught
+        if attempt + 1 < attempts:
+            import time as _time
+
+            _time.sleep(retry_sleep)
     return False
 
 
