@@ -1,0 +1,27 @@
+#!/bin/bash
+# One-shot TPU measurement session: fire everything the moment a claim
+# window opens, cheapest-first so a mid-session wedge still leaves
+# artifacts. Results land in benchmarks/results/*.tpu.json and stdout.
+#
+# Usage: bash benchmarks/run_tpu_matrix.sh [logfile]
+set -u
+cd "$(dirname "$0")/.."
+LOG="${1:-/tmp/tpu_matrix.log}"
+say() { echo "[tpu-matrix $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+say "smoke bench (validates kernels on chip, ~1 min when healthy)"
+BENCH_SMOKE=1 BENCH_CLAIM_TIMEOUT=120 BENCH_CLAIM_ATTEMPTS=2 \
+  timeout 900 python bench.py >>"$LOG" 2>&1 || { say "smoke FAILED"; exit 1; }
+
+say "full north-star bench"
+BENCH_CLAIM_TIMEOUT=120 BENCH_CLAIM_ATTEMPTS=2 BENCH_TPU_TIMEOUT=2400 \
+  timeout 2700 python bench.py 2>>"$LOG" | tee -a "$LOG"
+
+say "harness matrix on TPU (runtime-driven; dispatch-bound, numbers are honest)"
+timeout 1800 python -m benchmarks.basic_operations >>"$LOG" 2>&1 \
+  && say "basic_operations done" || say "basic_operations FAILED"
+timeout 1800 python -m benchmarks.propagation >>"$LOG" 2>&1 \
+  && say "propagation done" || say "propagation FAILED"
+timeout 2400 python -m benchmarks.full_bench >>"$LOG" 2>&1 \
+  && say "full_bench done" || say "full_bench FAILED"
+say "session complete; results in benchmarks/results/"
