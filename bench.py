@@ -390,6 +390,9 @@ def main():
         value = _run_tpu_child(env, tpu_timeout)
         if value is None:
             log("ACCELERATOR RUN FAILED — see stage logs above")
+    if value is None and os.environ.get("BENCH_NO_CPU_FALLBACK") == "1":
+        # interactive TPU sessions: a CPU number is useless, fail fast
+        raise SystemExit("accelerator run failed and BENCH_NO_CPU_FALLBACK=1")
     if value is None:
         # loud, labelled CPU fallback: the artifact must never silently
         # pass off a CPU number as the accelerator result
