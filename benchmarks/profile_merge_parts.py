@@ -111,6 +111,40 @@ def main():
 
     log(f"1-col scatter x64: {timed(lambda: f_scatter(stacked, sl))*1e3:.1f} ms")
 
+    # does one vector-valued scatter amortise the per-index cost that 7
+    # scalar-column scatters pay separately? (informs a packed-layout
+    # refactor: entries as [L, B, W] words, one scatter per merge)
+    E = 8192
+    idx = jnp.asarray(
+        rng.choice(L * B, size=E, replace=False).astype(np.int64)
+    )
+    vals1 = jnp.arange(E, dtype=jnp.uint32)
+    vals8 = jnp.broadcast_to(vals1[:, None], (E, 8))
+
+    @jax.jit
+    def f_scatter_scalar(cols, v):
+        # 7 separate scalar scatters at the same indices (current design)
+        outs = []
+        for c in range(7):
+            outs.append(cols[c].at[idx].set(v + c, mode="drop"))
+        return outs
+
+    cols7 = [jnp.zeros(L * B, jnp.uint32) for _ in range(7)]
+    log(
+        f"7 scalar scatters @ {E} idx: "
+        f"{timed(lambda: f_scatter_scalar(cols7, vals1))*1e3:.1f} ms"
+    )
+
+    @jax.jit
+    def f_scatter_vec(tbl, v):
+        return tbl.at[idx].set(v, mode="drop")
+
+    tbl8 = jnp.zeros((L * B, 8), jnp.uint32)
+    log(
+        f"1 vector scatter [E,8] @ {E} idx: "
+        f"{timed(lambda: f_scatter_vec(tbl8, vals8))*1e3:.1f} ms"
+    )
+
     @jax.jit
     def f_sort(s):
         return jnp.argsort(
