@@ -548,10 +548,16 @@ def merge_slice(
     # overflowing rows (pos >= B) must not clip into valid slots — drop.
     # Padding indices are DISTINCT out-of-bounds values (L*B + position):
     # the compacted scatter promises unique_indices, and duplicated
-    # sentinels would void that promise even though they are dropped
-    pad_idx = L * B + jnp.arange(u * s, dtype=jnp.int64).reshape(u, s)
+    # sentinels would void that promise even though they are dropped.
+    # 32-bit index math when the range fits (it always does at real
+    # geometries): TPU has no native i64 — a 64-bit argsort/scatter pays
+    # emulated two-word compares for nothing
+    idx_dtype = jnp.int32 if L * B + u * s < 2**31 else jnp.int64
+    pad_idx = L * B + jnp.arange(u * s, dtype=idx_dtype).reshape(u, s)
     flat = jnp.where(
-        ins & (pos < B), rows_clip[:, None] * B + jnp.clip(pos, 0, B - 1), pad_idx
+        ins & (pos < B),
+        rows_clip[:, None].astype(idx_dtype) * B + jnp.clip(pos, 0, B - 1),
+        pad_idx,
     )
     gid_of_entry = _table_lookup(
         sl.ctx_gid, jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1)
@@ -567,10 +573,13 @@ def merge_slice(
     else:
         # sort-compact: real insert positions (ascending) first, padding
         # (L*B) last — scatters then touch max_inserts sorted unique
-        # indices instead of the full padded grid
-        order = jnp.argsort(flat.reshape(-1))
-        sel = order[: min(max_inserts, flat.size)]
-        flat_c = flat.reshape(-1)[sel]
+        # indices instead of the full padded grid. top_k of the negated
+        # indices = the k smallest, already sorted: O(n log k) instead of
+        # a full O(n log² n) argsort, and it emits the compacted index
+        # values directly (flat is duplicate-free by construction)
+        k = min(max_inserts, flat.size)
+        neg_vals, sel = jax.lax.top_k(-flat.reshape(-1), k)
+        flat_c = -neg_vals
         need_ins_tier = n_inserted > sel.shape[0]
         sorted_hint = True
 
