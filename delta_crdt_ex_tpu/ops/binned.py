@@ -559,10 +559,6 @@ def merge_slice(
         rows_clip[:, None].astype(idx_dtype) * B + jnp.clip(pos, 0, B - 1),
         pad_idx,
     )
-    gid_of_entry = _table_lookup(
-        sl.ctx_gid, jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1)
-    )
-    eh_ins = entry_hash(sl.key, gid_of_entry, sl.ctr, sl.ts, sl.valh)
     n_inserted = jnp.sum(ins.astype(jnp.int32))
 
     if max_inserts is None:
@@ -583,12 +579,26 @@ def merge_slice(
         need_ins_tier = n_inserted > sel.shape[0]
         sorted_hint = True
 
-    def put(col, vals):
+    # compacted payload columns (the compacted branch computes the entry
+    # hash AFTER compaction: the grid-wide hash would burn emulated-u64
+    # mix rounds on ~90% padding)
+    take = lambda a: a.reshape(-1)[sel]
+    key_c = take(sl.key)
+    valh_c = take(sl.valh)
+    ts_c = take(sl.ts)
+    ctr_c = take(sl.ctr)
+    ln_c = take(ln_clip).astype(jnp.int32)
+    node_c = take(jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1))
+    eh_c = entry_hash(key_c, _table_lookup(sl.ctx_gid, node_c), ctr_c, ts_c, valh_c)
+    ins_c = flat_c < L * B  # real inserts; padding indices scatter-drop
+    rows_c = (flat_c // B).astype(jnp.int32)  # == L+ (dropped) for padding
+
+    def put(col, vals_c):
         return (
             col.reshape(-1)
             .at[flat_c]
             .set(
-                vals.reshape(-1)[sel],
+                vals_c,
                 mode="drop",
                 unique_indices=sorted_hint,
                 indices_are_sorted=sorted_hint,
@@ -596,29 +606,36 @@ def merge_slice(
             .reshape(L, B)
         )
 
-    key2 = put(state.key, sl.key)
-    valh2 = put(state.valh, sl.valh)
-    ts2 = put(state.ts, sl.ts)
-    node2 = put(state.node, ln_clip.astype(jnp.int32))
-    ctr2 = put(state.ctr, sl.ctr)
-    ehash2 = put(state.ehash, eh_ins)
-    alive2 = put(state.alive, ins)
+    key2 = put(state.key, key_c)
+    valh2 = put(state.valh, valh_c)
+    ts2 = put(state.ts, ts_c)
+    node2 = put(state.node, ln_c)
+    ctr2 = put(state.ctr, ctr_c)
+    ehash2 = put(state.ehash, eh_c)
+    alive2 = put(state.alive, ins_c)
     fill2 = state.fill.at[rows_safe].add(n_ins_row, mode="drop")
+    amin2 = state.amin.at[rows_c, ln_c].min(
+        jnp.where(ins_c, ctr_c, U32_MAX), mode="drop"
+    )
+    amax2 = state.amax.at[rows_c, ln_c].max(
+        jnp.where(ins_c, ctr_c, jnp.uint32(0)), mode="drop"
+    )
+    # leaf digests: in the compacted branch, scatter-add the k-bounded
+    # inserted hashes by row (duplicates accumulate; padding rows >= L
+    # drop; NOT unique — same-row inserts share a row index). The
+    # uncompacted branch row-reduces first so the scatter stays U index
+    # entries, not the full U*S grid.
     if max_inserts is None:
-        amin2 = state.amin.at[rows_clip[:, None], ln_clip].min(
-            jnp.where(ins, sl.ctr, U32_MAX), mode="drop"
+        leaf_add = jnp.sum(
+            jnp.where(ins & (pos < B), eh_c.reshape(u, s), jnp.uint32(0)),
+            axis=1,
+            dtype=jnp.uint32,
         )
-        amax2 = state.amax.at[rows_clip[:, None], ln_clip].max(
-            jnp.where(ins, sl.ctr, jnp.uint32(0)), mode="drop"
-        )
+        leaf2 = state.leaf.at[rows_safe].add(leaf_add, mode="drop")
     else:
-        rows_c = (flat_c // B).astype(jnp.int32)  # == L (dropped) for padding
-        ln_c = ln_clip.reshape(-1)[sel]
-        ctr_c = sl.ctr.reshape(-1)[sel]
-        amin2 = state.amin.at[rows_c, ln_c].min(ctr_c, mode="drop")
-        amax2 = state.amax.at[rows_c, ln_c].max(ctr_c, mode="drop")
-    leaf_add = jnp.sum(jnp.where(ins, eh_ins, jnp.uint32(0)), axis=1, dtype=jnp.uint32)
-    leaf2 = state.leaf.at[rows_safe].add(leaf_add, mode="drop")
+        leaf2 = state.leaf.at[rows_c].add(
+            jnp.where(ins_c, eh_c, jnp.uint32(0)), mode="drop"
+        )
     # context union, one scatter per remote writer column (the slice's
     # writer table is small; a [U, R] row scatter would cost U·R index
     # entries for mostly-empty rows)
