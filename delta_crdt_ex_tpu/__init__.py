@@ -39,8 +39,11 @@ __version__ = "0.1.0"
 __all__ = [
     "AWLWWMap",
     "DeltaCrdt",
+    "FileStorage",
     "MemoryStorage",
+    "Replica",
     "Storage",
+    "child_spec",
     "mutate",
     "mutate_async",
     "read",
@@ -57,7 +60,10 @@ _EXPORTS = {
     "AWLWWMap": ("delta_crdt_ex_tpu.models.binned_map", "BinnedAWLWWMap"),
     "DeltaCrdt": ("delta_crdt_ex_tpu.api", "DeltaCrdt"),
     "MemoryStorage": ("delta_crdt_ex_tpu.runtime.storage", "MemoryStorage"),
+    "FileStorage": ("delta_crdt_ex_tpu.runtime.storage", "FileStorage"),
+    "Replica": ("delta_crdt_ex_tpu.runtime.replica", "Replica"),
     "Storage": ("delta_crdt_ex_tpu.runtime.storage", "Storage"),
+    "child_spec": ("delta_crdt_ex_tpu.api", "child_spec"),
     "mutate": ("delta_crdt_ex_tpu.api", "mutate"),
     "mutate_async": ("delta_crdt_ex_tpu.api", "mutate_async"),
     "read": ("delta_crdt_ex_tpu.api", "read"),
