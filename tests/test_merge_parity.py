@@ -113,3 +113,35 @@ def test_large_writer_table_fallback_parity():
         assert read_binned_state(s8) == read_binned_state(s64), trial
         assert dots_of(s8) == dots_of(s64), trial
         assert np.array_equal(np.asarray(s8.leaf), np.asarray(s64.leaf)), trial
+
+
+def test_insert_compaction_tier_is_transparent():
+    """``max_inserts`` (top_k sort-compaction of the insert scatter) is a
+    pure cost-model knob: for any tier large enough to hold the inserts,
+    the merged state must be bit-identical to the uncompacted
+    (``max_inserts=None``) merge — including digests and summaries."""
+    rng = np.random.default_rng(3)
+    for trial in range(6):
+        L = 16
+        a = BinnedKernelMap(gid=100, capacity=256, rcap=4, num_buckets=L)
+        b = BinnedKernelMap(gid=200, capacity=256, rcap=4, num_buckets=L)
+        for ts in range(1, int(rng.integers(5, 30))):
+            who = a if rng.random() < 0.5 else b
+            k = int(rng.integers(0, 40))
+            if rng.random() < 0.8:
+                who.add(k, int(rng.integers(0, 100)), ts=ts)
+            else:
+                who.remove(k, ts=ts)
+        if trial % 2:
+            a.join_from(b)  # give the kill pass remote targets
+        sl = extract_rows(b.state, jnp.arange(L, dtype=jnp.int32))
+        r_none = merge_slice(a.state, sl, kill_budget=L, max_inserts=None)
+        for tier in (sl.key.size, 64, 256):
+            r_tier = merge_slice(a.state, sl, kill_budget=L, max_inserts=tier)
+            assert bool(r_tier.ok) == bool(r_none.ok), (trial, tier)
+            assert_states_equal(r_none.state, r_tier.state, (trial, tier))
+            assert int(r_none.n_inserted) == int(r_tier.n_inserted)
+        # an undersized tier must flag, not corrupt
+        if int(r_none.n_inserted) > 1:
+            r_small = merge_slice(a.state, sl, kill_budget=L, max_inserts=1)
+            assert not bool(r_small.ok) and bool(r_small.need_ins_tier), trial
