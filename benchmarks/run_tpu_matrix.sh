@@ -2,7 +2,7 @@
 # One-shot TPU measurement session: fire everything the moment a claim
 # window opens, cheapest-first so a mid-session wedge still leaves
 # artifacts. The north-star numbers go to stdout and $LOG (bench.py
-# prints its JSON line to stdout only); the three harness modules write
+# prints its JSON line to stdout only); the harness modules write
 # benchmarks/results/*.tpu.json. CPU fallbacks are disabled — this
 # script exists to measure the chip, a CPU number would be noise.
 #
@@ -35,4 +35,6 @@ timeout 1800 python -m benchmarks.propagation >>"$LOG" 2>&1 \
   && say "propagation done" || say "propagation FAILED"
 timeout 2400 python -m benchmarks.full_bench >>"$LOG" 2>&1 \
   && say "full_bench done" || say "full_bench FAILED"
+timeout 1200 python -m benchmarks.mesh_gossip >>"$LOG" 2>&1 \
+  && say "mesh_gossip done" || say "mesh_gossip FAILED"
 say "session complete; harness results in benchmarks/results/, north-star in /tmp/northstar.json"
