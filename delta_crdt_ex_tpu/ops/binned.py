@@ -655,7 +655,15 @@ def merge_slice(
     n_flagged = jnp.sum(flagged.astype(jnp.int32))
     need_kill_tier = n_flagged > kill_budget
 
-    order = jnp.argsort(~flagged, stable=True)[:kill_budget]  # flagged first
+    # flagged rows first, ascending index within each group — a top_k
+    # over a packed priority key (O(U log KB)) instead of a full stable
+    # argsort; all flagged rows outrank all unflagged ones, so the
+    # selection is identical whenever they fit the budget (and
+    # need_kill_tier already reports when they don't)
+    prio = flagged.astype(jnp.int32) * (2 * u) + jnp.arange(
+        u - 1, -1, -1, dtype=jnp.int32
+    )
+    _, order = jax.lax.top_k(prio, min(kill_budget, u))
     kb = order.shape[0]  # = min(kill_budget, U)
     k_valid = flagged[order]  # [KB]
     k_rows = jnp.where(k_valid, rows_clip[order], L)
