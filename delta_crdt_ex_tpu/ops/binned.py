@@ -125,6 +125,22 @@ def _row_table_lookup(tbl: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     return out
 
 
+def flagged_first_order(flags: jnp.ndarray, budget: int) -> jnp.ndarray:
+    """int32[min(budget, n)] indices: flagged positions first, ascending
+    index within each group — the selection a stable ``argsort(~flags)``
+    slice would make, via ``top_k`` over a packed priority key
+    (O(n log budget)). Keys are disjoint across groups and distinct
+    within (flagged: ``[2n, 3n)``, unflagged: ``[0, n)``), so the order
+    is fully determined; callers that need *all* flagged entries must
+    check the flagged count against ``budget`` themselves."""
+    n = flags.shape[0]
+    prio = flags.astype(jnp.int32) * (2 * n) + jnp.arange(
+        n - 1, -1, -1, dtype=jnp.int32
+    )
+    _, order = jax.lax.top_k(prio, min(budget, n))
+    return order
+
+
 def _row_amin(node, ctr, alive, u, r):
     """uint32[U, R] min alive counter per (row, writer slot)."""
     uu = jnp.broadcast_to(jnp.arange(u)[:, None], node.shape)
@@ -655,15 +671,9 @@ def merge_slice(
     n_flagged = jnp.sum(flagged.astype(jnp.int32))
     need_kill_tier = n_flagged > kill_budget
 
-    # flagged rows first, ascending index within each group — a top_k
-    # over a packed priority key (O(U log KB)) instead of a full stable
-    # argsort; all flagged rows outrank all unflagged ones, so the
-    # selection is identical whenever they fit the budget (and
-    # need_kill_tier already reports when they don't)
-    prio = flagged.astype(jnp.int32) * (2 * u) + jnp.arange(
-        u - 1, -1, -1, dtype=jnp.int32
-    )
-    _, order = jax.lax.top_k(prio, min(kill_budget, u))
+    # flagged rows first (need_kill_tier reports when they exceed the
+    # budget, so truncation is never silent)
+    order = flagged_first_order(flagged, kill_budget)
     kb = order.shape[0]  # = min(kill_budget, U)
     k_valid = flagged[order]  # [KB]
     k_rows = jnp.where(k_valid, rows_clip[order], L)

@@ -35,6 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from delta_crdt_ex_tpu.models.binned import BinnedStore
 from delta_crdt_ex_tpu.ops.binned import (
     extract_rows,
+    flagged_first_order,
     merge_rows,
     row_apply,
     tree_from_leaves,
@@ -132,14 +133,9 @@ def gossip_delta_step(
         prev_leaf = jax.lax.ppermute(st.leaf, AXIS, fwd)
         diff = prev_leaf != st.leaf
         n_diff = jnp.sum(diff.astype(jnp.int32))
-        # differing buckets first, ascending index — top_k over a packed
-        # priority key, same selection as a stable argsort at O(L log F)
-        # (see ops/binned.py kill pass for the equivalence argument)
-        nl = st.leaf.shape[0]
-        prio = diff.astype(jnp.int32) * (2 * nl) + jnp.arange(
-            nl - 1, -1, -1, dtype=jnp.int32
-        )
-        _, order = jax.lax.top_k(prio, min(frontier, nl))
+        # differing buckets first, ascending index (truncation beyond
+        # the frontier is healed by later steps; n_diff reports it)
+        order = flagged_first_order(diff, frontier)
         want = jnp.where(diff[order], order.astype(jnp.int32), -1)
 
         # 3. frontier request travels backward to the predecessor

@@ -27,7 +27,9 @@ mesh path.
 
 from __future__ import annotations
 
+import logging
 import pickle
+import zlib
 import queue
 import socket
 import struct
@@ -36,12 +38,23 @@ from typing import Any, Hashable
 
 from delta_crdt_ex_tpu.runtime.transport import Down
 
+logger = logging.getLogger("delta_crdt_ex_tpu")
+
 _LEN = struct.Struct(">I")
 
 # frame kinds
 _MSG = 0
 _PING = 1
 _PONG = 2
+_MSGZ = 3  # zlib-compressed _MSG — wire format addition; peers on an
+# older build ignore unknown kinds, so upgrade a cluster together (mixed
+# versions keep heartbeats green while large sync frames are dropped)
+
+#: compress frames at least this large. Sync payloads are padded
+#: static-shape arrays (mostly zeros), so cheap level-1 zlib typically
+#: shrinks them 10-50x — real bandwidth on the DCN leg; tiny control
+#: frames skip the round trip.
+_COMPRESS_MIN = 4096
 
 
 def _send_frame(sock: socket.socket, kind: int, payload: bytes) -> None:
@@ -278,10 +291,15 @@ class TcpTransport:
         on the connection's sender thread and return immediately."""
         _name, endpoint = addr
         payload = pickle.dumps(frame[1:], protocol=4)
+        kind = frame[0]
+        if kind == _MSG and len(payload) >= _COMPRESS_MIN:
+            z = zlib.compress(payload, 1)
+            if len(z) < 0.9 * len(payload):  # keep incompressible frames raw
+                payload, kind = z, _MSGZ
         conn = self._connect(endpoint)
         if conn is None:
             return False
-        return conn.enqueue(frame[0], payload)
+        return conn.enqueue(kind, payload)
 
     @staticmethod
     def _ping_roundtrip(sock: socket.socket) -> bool:
@@ -406,6 +424,11 @@ class TcpTransport:
                 elif kind == _MSG:
                     name, msg = pickle.loads(payload)
                     self.send(name, msg)
+                elif kind == _MSGZ:
+                    name, msg = pickle.loads(zlib.decompress(payload))
+                    self.send(name, msg)
+                else:
+                    logger.warning("dropping unknown frame kind %d (peer on a newer wire format?)", kind)
 
     # -- deterministic driving (parity with LocalTransport) ----------------
 
