@@ -328,9 +328,7 @@ def _device_backend_usable(timeout_s: float, attempts: int) -> bool:
         # fast UNAVAILABLE errors would burn all attempts in seconds —
         # space them out so a recovering claim can still be caught
         if attempt + 1 < attempts:
-            import time as _time
-
-            _time.sleep(retry_sleep)
+            time.sleep(retry_sleep)
     return False
 
 

@@ -93,13 +93,15 @@ def main():
         batches = [batch_for(n_ops, 2 + it, u, m) for it in range(iters)]
         t0 = time.perf_counter()
         st = stacked2
+        all_oks = []
         for b in batches:
             st, roots, oks, n_diff, _ = gossip_delta_step(
                 mesh, st, self_slot, *b, frontier=frontier
             )
+            all_oks.append(oks)
         jax.block_until_ready(roots)
         dt = (time.perf_counter() - t0) / iters
-        assert bool(np.asarray(oks).all())
+        assert all(bool(np.asarray(o).all()) for o in all_oks), "tier overflow mid-timing"
         results[f"step_ms@{n_ops}ops"] = round(dt * 1e3, 2)
         log(f"{n_ops} ops/replica/step: {dt*1e3:.1f} ms/step")
 
@@ -107,6 +109,7 @@ def main():
     st, roots, oks, n_diff, _ = gossip_delta_step(
         mesh, stacked, self_slot, *batch_for(64, 99, 128, 4), frontier=256
     )
+    assert bool(np.asarray(oks).all()), "tier overflow on the write wave"
     empty = (
         jnp.full((n, 1), -1, jnp.int32),
         jnp.full((n, 1, 1), OP_PAD, jnp.int32),
@@ -120,6 +123,7 @@ def main():
             mesh, st, self_slot, *empty, frontier=256
         )
         steps += 1
+        assert bool(np.asarray(oks).all()), "tier overflow during heal"
         if int(np.asarray(n_diff).max()) == 0:
             break
         assert steps < 4 * n, "ring did not converge"

@@ -34,7 +34,7 @@ DELTA = 512
 GROUP = 16
 RCAP = 8
 
-log = lambda *a: print(*a, file=sys.stderr, flush=True)
+from benchmarks.common import log  # shared stderr logger
 
 
 def timed(fn, *args, n=6, **kw):

@@ -3,8 +3,11 @@
 # window opens, cheapest-first so a mid-session wedge still leaves
 # artifacts. The north-star numbers go to stdout and $LOG (bench.py
 # prints its JSON line to stdout only); the harness modules write
-# benchmarks/results/*.tpu.json. CPU fallbacks are disabled — this
-# script exists to measure the chip, a CPU number would be noise.
+# benchmarks/results/*.tpu.json. CPU fallbacks are disabled for the two
+# bench.py runs (BENCH_NO_CPU_FALLBACK); the harness modules cannot fall
+# back silently either — the ambient JAX_PLATFORMS pin makes a dead
+# claim raise (step logs FAILED), and emit() stamps the backend into
+# every results filename, so a cpu artifact can never masquerade as tpu.
 #
 # Usage: bash benchmarks/run_tpu_matrix.sh [logfile]
 set -u

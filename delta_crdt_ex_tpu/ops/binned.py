@@ -903,7 +903,9 @@ def winners_for_keys(state: BinnedStore, khash: jnp.ndarray) -> KeyWinners:
     g_ts = state.ts[rows]
     g_key = state.key[rows]
     g_alive = state.alive[rows] & (g_key == khash[:, None])
-    g_gid = _table_lookup(state.ctx_gid, state.node[rows])
+    g_gid = _table_lookup(
+        state.ctx_gid, jnp.clip(state.node[rows], 0, state.replica_capacity - 1)
+    )
     g_ctr = state.ctr[rows]
     best = _argmax_lww(g_ts, g_gid, g_ctr, g_alive)
     take = lambda a: jnp.take_along_axis(a, best, axis=1)[:, 0]
@@ -942,7 +944,9 @@ def winner_rows(state: BinnedStore, rows: jnp.ndarray) -> RowWinners:
     key = state.key[rows_clip]
     ts = state.ts[rows_clip]
     ctr = state.ctr[rows_clip]
-    gid = _table_lookup(state.ctx_gid, state.node[rows_clip])
+    gid = _table_lookup(
+        state.ctx_gid, jnp.clip(state.node[rows_clip], 0, state.replica_capacity - 1)
+    )
     valh = state.valh[rows_clip]
     alive = state.alive[rows_clip] & valid[:, None]
 
@@ -966,8 +970,9 @@ def init_from_columns(state: BinnedStore) -> BinnedStore:
     invariant (:func:`compact_rows`). For host-constructed states
     (benchmarks, bulk loads): the host fills key/valh/ts/node/ctr/alive
     and the context tables; the device derives the rest in one pass."""
+    node_c = jnp.clip(state.node, 0, state.replica_capacity - 1)
     ehash = entry_hash(
-        state.key, _table_lookup(state.ctx_gid, state.node), state.ctr, state.ts, state.valh
+        state.key, _table_lookup(state.ctx_gid, node_c), state.ctr, state.ts, state.valh
     )
     return compact_rows(dataclasses.replace(state, ehash=ehash))
 

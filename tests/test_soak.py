@@ -58,6 +58,22 @@ def _run_soak(n_replicas: int, n_ops: int, seed: int):
     model: dict = {}
     partitioned: set[int] = set()
 
+    try:
+        _soak_steps(reps, rng, transport, model, rewire, n_replicas, n_ops,
+                    seed, clock, storage, partitioned)
+    finally:
+        # clean up even on assertion failure: lingering MemoryStorage
+        # snapshots would rehydrate into unrelated later tests
+        for r in reps:
+            try:
+                r.stop()
+            except Exception:
+                pass
+        MemoryStorage.clear()
+
+
+def _soak_steps(reps, rng, transport, model, rewire, n_replicas, n_ops,
+                seed, clock, storage, partitioned):
     for step in range(n_ops):
         who = int(rng.integers(0, n_replicas))
         op = rng.random()
@@ -114,9 +130,6 @@ def _run_soak(n_replicas: int, n_ops: int, seed: int):
     converge(transport, reps, rounds=10)
     for i, r in enumerate(reps):
         assert r.read() == model, (seed, "final", i)
-    for r in reps:
-        r.stop()
-    MemoryStorage.clear()
 
 
 def test_soak_miniature():
