@@ -38,6 +38,7 @@ __version__ = "0.2.0"
 
 __all__ = [
     "AWLWWMap",
+    "AWSet",
     "DeltaCrdt",
     "FileStorage",
     "MemoryStorage",
@@ -58,6 +59,7 @@ __all__ = [
 # `import delta_crdt_ex_tpu` backend-free.
 _EXPORTS = {
     "AWLWWMap": ("delta_crdt_ex_tpu.models.binned_map", "BinnedAWLWWMap"),
+    "AWSet": ("delta_crdt_ex_tpu.models.binned_map", "AWSet"),
     "DeltaCrdt": ("delta_crdt_ex_tpu.api", "DeltaCrdt"),
     "MemoryStorage": ("delta_crdt_ex_tpu.runtime.storage", "MemoryStorage"),
     "FileStorage": ("delta_crdt_ex_tpu.runtime.storage", "FileStorage"),

@@ -100,5 +100,6 @@ def mutate_async(crdt: Replica, f: str, args: list) -> None:
     crdt.mutate_async(f, args)
 
 
-def read(crdt: Replica, timeout: float = DEFAULT_TIMEOUT) -> dict[Any, Any]:
+def read(crdt: Replica, timeout: float = DEFAULT_TIMEOUT) -> "dict[Any, Any] | set":
+    """Resolved read: a dict for map models, a set for ``AWSet``."""
     return crdt.read(timeout)

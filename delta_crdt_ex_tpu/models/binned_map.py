@@ -244,3 +244,35 @@ class BinnedAWLWWMap:
     merge_into = staticmethod(merge_into)
     merge_rows_into = staticmethod(merge_rows_into)
     RowSlice = binned_ops.RowSlice
+
+    @staticmethod
+    def read_view(d: dict):
+        """Shape the resolved winner dict into this model's read form
+        (the map: identity). Models sharing the kernel table override
+        this (e.g. :class:`AWSet` below)."""
+        return d
+
+
+class AWSet(BinnedAWLWWMap):
+    """Add-wins observed-remove set — the second δ-CRDT of the reference
+    family (shipped by pre-0.4 versions of the Elixir library; v0.5.10
+    kept only AWLWWMap and the pluggable ``crdt_module`` seam this class
+    plugs into, ``delta_crdt.ex:56``).
+
+    Presence-only semantics over the identical kernel table: an element
+    is a key whose stored value is the constant ``True``; adds/removes
+    keep full add-wins observed-remove behaviour (a concurrent add
+    survives a remove that did not observe it), and ``read`` returns the
+    member set. Diffs feed as ``("add", elem, True)`` / ``("remove",
+    elem)``.
+    """
+
+    OPS = {
+        "add": (OP_ADD, 1),  # add(elem)
+        "remove": (OP_REMOVE, 1),  # remove(elem)
+        "clear": (OP_CLEAR, 0),  # clear()
+    }
+
+    @staticmethod
+    def read_view(d: dict):
+        return set(d)

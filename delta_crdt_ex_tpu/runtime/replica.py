@@ -258,7 +258,11 @@ class Replica:
         if len(args) != arity:
             raise ValueError(f"{f} expects {arity} argument(s), got {len(args)}")
         if f == "add":
-            self._pending.append(("add", args[0], args[1]))
+            # value-less models (e.g. AWSet, arity 1) store the constant
+            # True — present-ness is the value, and a non-None value keeps
+            # the `add k, nil ⇒ remove` diff rule map-only
+            value = args[1] if arity == 2 else True
+            self._pending.append(("add", args[0], value))
         elif f == "remove":
             self._pending.append(("remove", args[0], None))
         else:
@@ -269,18 +273,20 @@ class Replica:
         with self._lock:
             self._flush()
 
-    def read(self, timeout: float | None = None) -> dict:
+    def read(self, timeout: float | None = None) -> "dict | set":
+        # AWLWWMap -> dict; value-less models (AWSet) -> set (read_view)
         self._acquire(timeout, "read")
         try:
             self._flush()
             if self._read_cache is None:
                 self._read_cache = self._read_all()
-            return dict(self._read_cache)
+            return self.model.read_view(dict(self._read_cache))
         finally:
             self._lock.release()
 
-    def read_keys(self, key_terms: list) -> dict:
-        """Partial read (reference ``AWLWWMap.read/2``, ``aw_lww_map.ex:218-224``)."""
+    def read_keys(self, key_terms: list) -> "dict | set":
+        """Partial read (reference ``AWLWWMap.read/2``, ``aw_lww_map.ex:
+        218-224``) — a dict for map models, the member subset for AWSet."""
         with self._lock:
             self._flush()
             hashes = [key_hash64(k) for k in key_terms]
@@ -297,7 +303,7 @@ class Replica:
                 if found[i]:
                     dot = (int(gid[i]), int(hashes[i]) & mask, int(ctr[i]))
                     out[term] = self._payloads[dot][1]
-            return out
+            return self.model.read_view(out)
 
     def set_neighbours(self, neighbours: list) -> None:
         """One-way sync edges (reference ``{:set_neighbours, …}``,

@@ -1,0 +1,68 @@
+"""AWSet: the presence-only δ-CRDT over the shared kernel table
+(restores the set type earlier versions of the reference family shipped;
+plugs into the ``crdt_module`` seam, ``delta_crdt.ex:56``)."""
+
+from delta_crdt_ex_tpu import AWSet
+from delta_crdt_ex_tpu.api import mutate, read, set_neighbours, start_link
+from tests.conftest import converge
+
+
+def mk(transport, clock, **opts):
+    opts.setdefault("capacity", 64)
+    opts.setdefault("tree_depth", 5)
+    return start_link(AWSet, threaded=False, transport=transport, clock=clock, **opts)
+
+
+def test_two_replica_set_convergence(transport, shared_clock):
+    a = mk(transport, shared_clock)
+    b = mk(transport, shared_clock)
+    set_neighbours(a, [b])
+    set_neighbours(b, [a])
+    mutate(a, "add", ["x"])
+    mutate(b, "add", [("tuple", 1)])
+    converge(transport, [a, b])
+    assert read(a) == read(b) == {"x", ("tuple", 1)}
+    mutate(a, "remove", ["x"])
+    converge(transport, [a, b])
+    assert read(b) == {("tuple", 1)}
+
+
+def test_add_wins_on_concurrent_add_remove(transport, shared_clock):
+    a = mk(transport, shared_clock)
+    b = mk(transport, shared_clock)
+    set_neighbours(a, [b])
+    set_neighbours(b, [a])
+    mutate(a, "add", ["e"])
+    converge(transport, [a, b])
+    # concurrent: b removes (observing a's dot), a re-adds with a fresh dot
+    mutate(b, "remove", ["e"])
+    mutate(a, "add", ["e"])
+    converge(transport, [a, b])
+    assert read(a) == read(b) == {"e"}  # the unobserved add survives
+
+
+def test_clear_and_diffs(transport, shared_clock):
+    seen = []
+    a = mk(transport, shared_clock, on_diffs=seen.append)
+    mutate(a, "add", ["p"])
+    assert seen == [[("add", "p", True)]]
+    mutate(a, "clear", [])
+    assert read(a) == set()
+    assert seen[-1] == [("remove", "p")]
+
+
+def test_partial_read_keys(transport, shared_clock):
+    a = mk(transport, shared_clock)
+    for e in range(10):
+        a.mutate_async("add", [e])
+    a.flush()
+    assert a.read_keys([3, 7, 99]) == {3, 7}
+
+
+def test_arity_validation(transport, shared_clock):
+    a = mk(transport, shared_clock)
+    try:
+        mutate(a, "add", ["k", "v"])
+        raise AssertionError("2-arg add must be rejected for AWSet")
+    except ValueError:
+        pass

@@ -59,8 +59,64 @@ def _run_soak(n_replicas: int, n_ops: int, seed: int):
     partitioned: set[int] = set()
 
     try:
-        _soak_steps(reps, rng, transport, model, rewire, n_replicas, n_ops,
-                    seed, clock, storage, partitioned)
+        for step in range(n_ops):
+            who = int(rng.integers(0, n_replicas))
+            op = rng.random()
+            key = int(rng.integers(1, 40))
+            # During a partition only ADDS keep the dict an exact oracle
+            # (the shared clock makes LWW == program order); a remove or
+            # clear issued on one side cannot observe the other side's
+            # concurrent adds, so add-wins would legitimately disagree
+            # with the dict (that divergence is covered by test_simnet).
+            if partitioned and op >= 0.62:
+                op = op * 0.62 if op < 0.86 else op  # remap mutations to add
+            if op < 0.62:
+                # adds never need convergence for dict-exactness: the
+                # shared clock makes global LWW order == program order
+                val = int(rng.integers(0, 1000))
+                reps[who].mutate("add", [key, val])
+                model[key] = val
+            elif op < 0.82:
+                # a remove is dict-exact only if the remover has OBSERVED
+                # every prior dot (observed-remove): converge first
+                converge(transport, reps, rounds=8)
+                reps[who].mutate("remove", [key])
+                model.pop(key, None)
+            elif op < 0.86:
+                converge(transport, reps, rounds=8)
+                reps[who].mutate("clear", [])
+                model.clear()
+            elif op < 0.92 and not partitioned:
+                # partition a random nonempty proper subset
+                k = int(rng.integers(1, n_replicas))
+                partitioned = set(
+                    int(x) for x in rng.choice(n_replicas, k, replace=False)
+                )
+                rewire(partitioned)
+            elif op < 0.96 and partitioned:
+                partitioned = set()
+                rewire(partitioned)  # heal
+            else:
+                # crash a replica (no terminate sync), rehydrate from storage
+                victim = int(rng.integers(0, n_replicas))
+                name = reps[victim].name
+                transport.unregister(reps[victim].addr)
+                reps[victim] = _mk(transport, clock, name, storage)
+                rewire(partitioned)
+
+            # under partition the sides diverge; only assert on full heals.
+            # Ops during a partition only reach the writer's side, so the
+            # oracle is maintained but checked when everyone can see it.
+            if not partitioned and (step % 7 == 0 or step == n_ops - 1):
+                converge(transport, reps, rounds=8)
+                for i, r in enumerate(reps):
+                    assert r.read() == model, (seed, step, i)
+
+        if partitioned:
+            rewire(set())
+        converge(transport, reps, rounds=10)
+        for i, r in enumerate(reps):
+            assert r.read() == model, (seed, "final", i)
     finally:
         # clean up even on assertion failure: lingering MemoryStorage
         # snapshots would rehydrate into unrelated later tests
@@ -70,66 +126,6 @@ def _run_soak(n_replicas: int, n_ops: int, seed: int):
             except Exception:
                 pass
         MemoryStorage.clear()
-
-
-def _soak_steps(reps, rng, transport, model, rewire, n_replicas, n_ops,
-                seed, clock, storage, partitioned):
-    for step in range(n_ops):
-        who = int(rng.integers(0, n_replicas))
-        op = rng.random()
-        key = int(rng.integers(1, 40))
-        # During a partition only ADDS keep the dict an exact oracle
-        # (the shared clock makes LWW == program order); a remove/clear
-        # issued on one side cannot observe the other side's concurrent
-        # adds, so add-wins would legitimately disagree with the dict
-        # (that divergence behaviour is covered by test_simnet.py).
-        if partitioned and op >= 0.62:
-            op = op * 0.62 if op < 0.86 else op  # remap mutations to add
-        if op < 0.62:
-            # adds never need convergence for dict-exactness: the shared
-            # clock makes global LWW order == program order
-            val = int(rng.integers(0, 1000))
-            reps[who].mutate("add", [key, val])
-            model[key] = val
-        elif op < 0.82:
-            # a remove is dict-exact only if the remover has OBSERVED
-            # every prior dot (observed-remove semantics): converge first
-            converge(transport, reps, rounds=8)
-            reps[who].mutate("remove", [key])
-            model.pop(key, None)
-        elif op < 0.86:
-            converge(transport, reps, rounds=8)
-            reps[who].mutate("clear", [])
-            model.clear()
-        elif op < 0.92 and not partitioned:
-            # partition a random nonempty proper subset
-            k = int(rng.integers(1, n_replicas))
-            partitioned = set(int(x) for x in rng.choice(n_replicas, k, replace=False))
-            rewire(partitioned)
-        elif op < 0.96 and partitioned:
-            partitioned = set()
-            rewire(partitioned)  # heal
-        else:
-            # crash a replica (no terminate sync) and rehydrate from storage
-            victim = int(rng.integers(0, n_replicas))
-            name = reps[victim].name
-            transport.unregister(reps[victim].addr)
-            reps[victim] = _mk(transport, clock, name, storage)
-            rewire(partitioned)
-
-        # under partition the sides diverge; only assert on full heals.
-        # Ops during a partition only reach the writer's side, so the
-        # oracle is maintained but checked when everyone can see it.
-        if not partitioned and (step % 7 == 0 or step == n_ops - 1):
-            converge(transport, reps, rounds=8)
-            for i, r in enumerate(reps):
-                assert r.read() == model, (seed, step, i)
-
-    if partitioned:
-        rewire(set())
-    converge(transport, reps, rounds=10)
-    for i, r in enumerate(reps):
-        assert r.read() == model, (seed, "final", i)
 
 
 def test_soak_miniature():
