@@ -66,3 +66,31 @@ def test_arity_validation(transport, shared_clock):
         raise AssertionError("2-arg add must be rejected for AWSet")
     except ValueError:
         pass
+
+
+def test_set_scripts_match_set_oracle(transport, shared_clock):
+    """Random fully-synced scripts vs a python set (the oracle pattern of
+    ``aw_lww_map_property_test.exs`` at the set's semantics)."""
+    import numpy as np
+
+    rng = np.random.default_rng(5)
+    reps = [mk(transport, shared_clock) for _ in range(3)]
+    for r in reps:
+        r.set_neighbours([x for x in reps if x is not r])
+    model: set = set()
+    for step in range(60):
+        who = reps[int(rng.integers(0, 3))]
+        elem = int(rng.integers(0, 12))
+        roll = rng.random()
+        if roll < 0.6:
+            who.mutate("add", [elem])
+            model.add(elem)
+        elif roll < 0.9:
+            who.mutate("remove", [elem])
+            model.discard(elem)
+        else:
+            who.mutate("clear", [])
+            model.clear()
+        converge(transport, reps)
+        for i, r in enumerate(reps):
+            assert r.read() == model, (step, i)
