@@ -94,3 +94,20 @@ def test_set_scripts_match_set_oracle(transport, shared_clock):
         converge(transport, reps)
         for i, r in enumerate(reps):
             assert r.read() == model, (step, i)
+
+
+def test_set_crash_rehydrate(transport, shared_clock):
+    """Crash (no terminate sync) + rehydrate keeps membership AND node-id
+    continuity for the set model (``causal_crdt_test.exs:87-102``)."""
+    from delta_crdt_ex_tpu.runtime.storage import MemoryStorage
+
+    storage = MemoryStorage()
+    a = mk(transport, shared_clock, name="awset-st", storage_module=storage)
+    for e in ("x", "y", "z"):
+        mutate(a, "add", [e])
+    mutate(a, "remove", ["y"])
+    node_id = a.node_id
+    transport.unregister(a.addr)  # crash
+    b = mk(transport, shared_clock, name="awset-st", storage_module=storage)
+    assert read(b) == {"x", "z"}
+    assert b.node_id == node_id  # dot-counter continuity
