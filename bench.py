@@ -25,12 +25,21 @@ Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": "merges/sec", "vs_baseline": N}
 
 Env knobs: BENCH_SMOKE=1 shrinks sizes for CPU smoke runs.
+
+Deadline contract: the whole run fits one wall-clock budget
+(``BENCH_TOTAL_BUDGET`` seconds, default 1380 — comfortably under a
+30-minute external timeout). The claim probe and the device child only
+get the budget *minus* a reserve for the labelled CPU fallback, so the
+fallback always has time to run; and a JSON line is guaranteed on every
+exit path (deadline exhaustion, claim failure, child crash, SIGTERM)
+— a bench that can exit with no artifact is a broken bench.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
 import sys
 import time
 
@@ -171,6 +180,7 @@ def bench_tpu(seed=0):
     # secondary evidence (stderr only): per-merge dispatch at GROUP=1 —
     # the O(slice) criterion is "GROUP=1 merges/sec within 2x of
     # GROUP=16" (one 512-entry slice per call, same 64-neighbour vmap)
+    secondary_assert_failed = False
     try:
         n1 = 4
         slices1, _ = interval_delta_stream(
@@ -198,9 +208,14 @@ def bench_tpu(seed=0):
             f"group=1 secondary: {g1:.1f} merges/sec "
             f"(group={GROUP}: {merges / dt:.1f}; ratio {(merges / dt) / g1:.2f}x)"
         )
+    except AssertionError as e:
+        # a tier overflow is a correctness signal, not a perf hiccup —
+        # it must be distinguishable in the artifact, not just a log line
+        secondary_assert_failed = True
+        log(f"group=1 secondary OVERFLOW ASSERTION: {e!r}")
     except Exception as e:  # never let the secondary kill the artifact
         log(f"group=1 secondary failed: {e!r}")
-    return merges / dt
+    return merges / dt, secondary_assert_failed
 
 
 def partial_jit_donate(fn):
@@ -297,24 +312,51 @@ def bench_python(seed=0):
     return merges / dt
 
 
-def _device_backend_usable(timeout_s: float, attempts: int) -> bool:
+class Budget:
+    """One shared wall-clock budget for the whole bench run.
+
+    Every stage asks ``remaining()`` (optionally minus a reserve for the
+    stages that MUST still run after it) instead of using its own
+    unbounded timeout — this is what guarantees the labelled CPU
+    fallback always gets its turn before any external timeout fires."""
+
+    def __init__(self, total_s: float):
+        self.t0 = time.monotonic()
+        self.total = total_s
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self.t0
+
+    def remaining(self, reserve: float = 0.0) -> float:
+        return max(0.0, self.total - self.elapsed() - reserve)
+
+
+def _device_backend_usable(budget: Budget, reserve: float,
+                           timeout_s: float, attempts: int) -> bool:
     """Probe whether the configured accelerator backend can initialise.
 
     Device init goes through an external claim that can hang indefinitely
     when the pool is wedged (a killed holder's grant can take a long time
     to expire) — probe in a subprocess with a watchdog, retrying so a
-    recovering claim still gets picked up.
+    recovering claim still gets picked up. Deadline-aware: never spends
+    past ``budget`` minus ``reserve`` (the time the device child + CPU
+    fallback still need), however many attempts were asked for.
     """
     import subprocess
 
     if os.environ.get("JAX_PLATFORMS", "") in ("cpu", ""):
         return True
-    retry_sleep = float(os.environ.get("BENCH_CLAIM_RETRY_SLEEP", "120"))
+    retry_sleep = float(os.environ.get("BENCH_CLAIM_RETRY_SLEEP", "60"))
     for attempt in range(attempts):
+        probe_budget = min(timeout_s, budget.remaining(reserve))
+        if probe_budget < 15:
+            log(f"claim probe out of budget (remaining {budget.remaining():.0f}s, "
+                f"reserve {reserve:.0f}s) — surrendering to fallback")
+            return False
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=timeout_s,
+                timeout=probe_budget,
                 capture_output=True,
             )
             if proc.returncode == 0:
@@ -322,22 +364,26 @@ def _device_backend_usable(timeout_s: float, attempts: int) -> bool:
             log(f"device claim probe failed (attempt {attempt + 1}/{attempts}): "
                 f"{proc.stderr.decode(errors='replace')[-300:]}")
         except subprocess.TimeoutExpired:
-            log(f"device claim probe timed out after {timeout_s:.0f}s "
+            log(f"device claim probe timed out after {probe_budget:.0f}s "
                 f"(attempt {attempt + 1}/{attempts}) — claim may be wedged")
             continue  # the timeout already consumed the attempt's patience
         # fast UNAVAILABLE errors would burn all attempts in seconds —
-        # space them out so a recovering claim can still be caught
+        # space them out so a recovering claim can still be caught, but
+        # never sleep past the budget
         if attempt + 1 < attempts:
-            time.sleep(retry_sleep)
+            time.sleep(min(retry_sleep, budget.remaining(reserve)))
     return False
 
 
-def _run_tpu_child(env: dict, timeout_s: float) -> float | None:
+def _run_tpu_child(env: dict, timeout_s: float) -> dict | None:
     """Run the device side (``--tpu-child``) in a subprocess with a hard
-    watchdog; returns merges/sec or None. The child claims the device,
-    so the parent never imports jax and cannot wedge."""
+    watchdog; returns the child's result dict or None. The child claims
+    the device, so the parent never imports jax and cannot wedge."""
     import subprocess
 
+    if timeout_s < 30:
+        log(f"device bench child skipped: only {timeout_s:.0f}s left in budget")
+        return None
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--tpu-child"],
@@ -354,72 +400,178 @@ def _run_tpu_child(env: dict, timeout_s: float) -> float | None:
         log(f"device bench child failed (exit {proc.returncode})")
         return None
     try:
-        return float(json.loads(proc.stdout.decode().strip().splitlines()[-1])["merges_per_sec"])
+        res = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+        float(res["merges_per_sec"])
+        return res
     except (ValueError, KeyError, IndexError):
         log(f"device bench child printed no result: {proc.stdout[-300:]!r}")
         return None
 
 
-def main():
-    if "--tpu-child" in sys.argv:
-        print(json.dumps({"merges_per_sec": bench_tpu()}), flush=True)
+_EMITTED = False
+
+
+def _emit(obj: dict) -> None:
+    """Print THE one JSON line, exactly once per process.
+
+    The emitted flag flips only after the print completes: a SIGTERM
+    landing mid-emission lets the handler's line still go out (the
+    driver parses the LAST line, so a rare double emission is harmless;
+    an empty stdout is not)."""
+    global _EMITTED
+    if _EMITTED:
         return
+    print(json.dumps(obj), flush=True)
+    _EMITTED = True
 
-    log(
-        f"workload: {N_KEYS} keys, {NEIGHBOURS} neighbours, {DELTA}-entry "
-        f"delta-interval slices, L=2^{TREE_DEPTH} buckets"
-    )
-    py = bench_python()
 
-    # a wedged claim (killed holder's grant) can take tens of minutes to
-    # expire — probe patiently before surrendering to the CPU fallback
-    claim_timeout = float(os.environ.get("BENCH_CLAIM_TIMEOUT", "300"))
-    claim_attempts = int(os.environ.get("BENCH_CLAIM_ATTEMPTS", "6"))
-    tpu_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", "2400"))
-
-    value = None
-    fallback = os.environ.get("BENCH_FORCED_CPU") == "1"
-    if not fallback and _device_backend_usable(claim_timeout, claim_attempts):
-        env = dict(os.environ)
-        if env.get("JAX_PLATFORMS") == "cpu":
-            # an explicitly-CPU run must also bypass the axon boot hook,
-            # or the child wedges on the remote claim it never needed
-            env["PALLAS_AXON_POOL_IPS"] = ""
-        value = _run_tpu_child(env, tpu_timeout)
-        if value is None:
-            log("ACCELERATOR RUN FAILED — see stage logs above")
-    if value is None and os.environ.get("BENCH_NO_CPU_FALLBACK") == "1":
-        # interactive TPU sessions: a CPU number is useless, fail fast
-        raise SystemExit("accelerator run failed and BENCH_NO_CPU_FALLBACK=1")
-    if value is None:
-        # loud, labelled CPU fallback: the artifact must never silently
-        # pass off a CPU number as the accelerator result
-        fallback = True
-        log("falling back to CPU (metric labelled _cpu_fallback)")
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["PALLAS_AXON_POOL_IPS"] = ""
-        value = _run_tpu_child(env, tpu_timeout)
-        if value is None:
-            raise SystemExit("bench failed on accelerator AND cpu")
-
+def _metric_name(fallback: bool) -> str:
     metric = (
         "awlwwmap_1m_key_64_neighbour_merges_per_sec"
         if not SMOKE
         else "awlwwmap_smoke_merges_per_sec"
     )
-    if fallback:
-        metric += "_cpu_fallback"
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(value, 2),
+    return metric + ("_cpu_fallback" if fallback else "")
+
+
+def main():
+    if "--tpu-child" in sys.argv:
+        mps, sec_failed = bench_tpu()
+        out = {"merges_per_sec": mps}
+        if sec_failed:
+            out["secondary_assert_failed"] = True
+        print(json.dumps(out), flush=True)
+        return
+
+    # ---- the artifact guarantee -------------------------------------
+    # One wall-clock budget covers everything; the CPU fallback has a
+    # reserved slice of it; and if ANYTHING still goes wrong (including
+    # an external SIGTERM landing before we finish) a labelled JSON
+    # line goes out anyway. BENCH_r02 died with no artifact — never again.
+    budget = Budget(float(os.environ.get("BENCH_TOTAL_BUDGET", "1380")))
+    fallback_reserve = float(os.environ.get("BENCH_FALLBACK_RESERVE", "480"))
+    if os.environ.get("BENCH_NO_CPU_FALLBACK") == "1":
+        fallback_reserve = 0.0
+    py_holder = {"py": None}
+
+    def _interrupted(signum, frame):
+        log(f"signal {signum} received at +{budget.elapsed():.0f}s — emitting last-resort artifact")
+        py = py_holder["py"]
+        _emit({
+            "metric": _metric_name(fallback=True) + "_interrupted",
+            "value": 0.0,
+            "unit": "merges/sec",
+            "vs_baseline": 0.0,
+            "error": f"interrupted by signal {signum} before completion",
+            "py_baseline_merges_per_sec": py and round(py, 2),
+        })
+        sys.stdout.flush()
+        raise SystemExit(1)
+
+    signal.signal(signal.SIGTERM, _interrupted)
+    signal.signal(signal.SIGINT, _interrupted)
+
+    try:
+        _main_measured(budget, fallback_reserve, py_holder)
+    except BaseException as e:  # noqa: BLE001 — artifact guarantee
+        import traceback
+
+        traceback.print_exc()
+        if not _EMITTED:
+            log(f"bench failed without artifact: {e!r} — emitting error line")
+            _emit({
+                "metric": _metric_name(fallback=True) + "_failed",
+                "value": 0.0,
                 "unit": "merges/sec",
-                "vs_baseline": round(value / py, 3),
-            }
-        )
+                "vs_baseline": 0.0,
+                "error": repr(e)[:300],
+            })
+        # the artifact is the contract: once the line is out, exit 0 so
+        # the driver records it (failure is visible in the metric label)
+        raise SystemExit(0) from e
+
+
+def _main_measured(budget: Budget, fallback_reserve: float, py_holder: dict):
+    log(
+        f"workload: {N_KEYS} keys, {NEIGHBOURS} neighbours, {DELTA}-entry "
+        f"delta-interval slices, L=2^{TREE_DEPTH} buckets; "
+        f"budget {budget.total:.0f}s (fallback reserve {fallback_reserve:.0f}s)"
     )
+    py = bench_python()
+    py_holder["py"] = py
+
+    # a wedged claim (killed holder's grant) can take tens of minutes to
+    # expire — probe patiently, but only within the shared budget
+    claim_timeout = float(os.environ.get("BENCH_CLAIM_TIMEOUT", "240"))
+    claim_attempts = int(os.environ.get("BENCH_CLAIM_ATTEMPTS", "3"))
+    tpu_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", "2400"))
+    # the device child needs real time after a successful probe; keep it
+    # out of the probe's spendable window too
+    child_floor = 240.0
+
+    res = None
+    fallback = os.environ.get("BENCH_FORCED_CPU") == "1"
+    if not fallback and _device_backend_usable(
+        budget, fallback_reserve + child_floor, claim_timeout, claim_attempts
+    ):
+        env = dict(os.environ)
+        if env.get("JAX_PLATFORMS") == "cpu":
+            # an explicitly-CPU run must also bypass the axon boot hook,
+            # or the child wedges on the remote claim it never needed
+            env["PALLAS_AXON_POOL_IPS"] = ""
+        res = _run_tpu_child(
+            env, min(tpu_timeout, budget.remaining(fallback_reserve))
+        )
+        if res is None:
+            log("ACCELERATOR RUN FAILED — see stage logs above")
+    if res is None and os.environ.get("BENCH_NO_CPU_FALLBACK") == "1":
+        # interactive TPU sessions: a CPU number is useless, fail fast
+        # (main() still guarantees an error-labelled artifact line)
+        raise SystemExit("accelerator run failed and BENCH_NO_CPU_FALLBACK=1")
+    if res is None:
+        # loud, labelled CPU fallback: the artifact must never silently
+        # pass off a CPU number as the accelerator result
+        fallback = True
+        log(f"falling back to CPU at +{budget.elapsed():.0f}s "
+            f"({budget.remaining():.0f}s left; metric labelled _cpu_fallback)")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        if not SMOKE and budget.remaining() < fallback_reserve * 0.75:
+            # not enough left for the full-config CPU run — a labelled
+            # smoke number (with its own matched smoke baseline) still
+            # beats an empty artifact: re-run the whole bench in smoke
+            # mode and relay its artifact line verbatim
+            log("budget too thin for full CPU fallback — relaying smoke run")
+            import subprocess
+
+            env["BENCH_SMOKE"] = "1"
+            env["BENCH_FORCED_CPU"] = "1"
+            env["BENCH_TOTAL_BUDGET"] = str(max(30.0, budget.remaining() - 15.0))
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                timeout=max(45.0, budget.remaining() - 5.0),
+                env=env, capture_output=True,
+            )
+            sys.stderr.buffer.write(proc.stderr)
+            _emit(json.loads(proc.stdout.decode().strip().splitlines()[-1]))
+            return
+        res = _run_tpu_child(env, max(30.0, budget.remaining() - 20.0))
+        if res is None:
+            raise SystemExit("bench failed on accelerator AND cpu")
+
+    value = float(res["merges_per_sec"])
+    line = {
+        "metric": _metric_name(fallback),
+        "value": round(value, 2),
+        "unit": "merges/sec",
+        "vs_baseline": round(value / py, 3),
+    }
+    if res.get("secondary_assert_failed"):
+        # tier overflow in the GROUP=1 secondary is a correctness
+        # signal — surface it in the artifact, not only in stderr
+        line["secondary_assert_failed"] = True
+    _emit(line)
 
 
 if __name__ == "__main__":

@@ -134,6 +134,10 @@ def flagged_first_order(flags: jnp.ndarray, budget: int) -> jnp.ndarray:
     is fully determined; callers that need *all* flagged entries must
     check the flagged count against ``budget`` themselves."""
     n = flags.shape[0]
+    # key range is [0, 3n): past int32 it would overflow and silently
+    # scramble the order — unreachable at current geometries (leaves are
+    # ~2^14) but the helper is generic, so guard it
+    assert 3 * n < 2**31, f"flagged_first_order int32 key overflow: n={n}"
     prio = flags.astype(jnp.int32) * (2 * n) + jnp.arange(
         n - 1, -1, -1, dtype=jnp.int32
     )

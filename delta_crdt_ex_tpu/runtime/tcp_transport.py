@@ -46,9 +46,14 @@ _LEN = struct.Struct(">I")
 _MSG = 0
 _PING = 1
 _PONG = 2
-_MSGZ = 3  # zlib-compressed _MSG — wire format addition; peers on an
-# older build ignore unknown kinds, so upgrade a cluster together (mixed
-# versions keep heartbeats green while large sync frames are dropped)
+_MSGZ = 3  # zlib-compressed _MSG — only sent to peers that advertised
+# _FEAT_MSGZ in the HELLO exchange (legacy peers get plain _MSG frames,
+# so mixed-version clusters keep converging; see MIGRATING.md)
+_HELLO = 4  # capability negotiation: payload = [wire_version, features]
+
+_WIRE_VERSION = 1
+_FEAT_MSGZ = 1  # feature bit: peer accepts zlib-compressed _MSG frames
+_OUR_FEATURES = _FEAT_MSGZ
 
 #: compress frames at least this large. Sync payloads are padded
 #: static-shape arrays (mostly zeros), so cheap level-1 zlib typically
@@ -59,6 +64,48 @@ _COMPRESS_MIN = 4096
 
 def _send_frame(sock: socket.socket, kind: int, payload: bytes) -> None:
     sock.sendall(_LEN.pack(len(payload) + 1) + bytes([kind]) + payload)
+
+
+def _recv_frame(sock: socket.socket) -> tuple[int, bytes] | None:
+    """Read one length-prefixed frame; ``(kind, payload)`` or None on a
+    short read. The single wire-format parse — every reader (serve loop,
+    ping round-trip, HELLO waiter) goes through here."""
+    hdr = _recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    body = _recv_exact(sock, _LEN.unpack(hdr)[0])
+    if not body:
+        return None
+    return body[0], body[1:]
+
+
+def _start_hello_negotiation(conn: "_SenderConn") -> None:
+    """Negotiate wire capabilities on a fresh outbound connection,
+    without ever blocking the send path.
+
+    Sends our HELLO, then a short-lived daemon thread waits for the
+    peer's reply and flips the connection's feature flags when it lands.
+    Until then (and forever, for an older peer that drops unknown frame
+    kinds and never replies) the connection advertises no optional
+    features — compression is never sent to a peer that did not claim
+    it, so a rolling upgrade cannot silently stop convergence, and a
+    stalled peer costs the caller nothing (frames just stay uncompressed).
+    """
+    try:
+        _send_frame(conn.sock, _HELLO, bytes([_WIRE_VERSION, _OUR_FEATURES]))
+    except OSError:
+        return  # the sender thread will discover the dead socket itself
+
+    def wait_reply() -> None:
+        try:
+            frame = _recv_frame(conn.sock)
+            if frame is not None and frame[0] == _HELLO and len(frame[1]) >= 2:
+                conn.accepts_z = bool(frame[1][1] & _FEAT_MSGZ)
+        except OSError:
+            pass  # timeout/reset: stay feature-less
+
+    threading.Thread(target=wait_reply, daemon=True,
+                     name="tcp-hello-wait").start()
 
 
 class _SenderConn:
@@ -73,8 +120,10 @@ class _SenderConn:
 
     QUEUE_MAX = 256
 
-    def __init__(self, sock: socket.socket, on_dead) -> None:
+    def __init__(self, sock: socket.socket, on_dead, accepts_z: bool = False) -> None:
         self.sock = sock
+        #: negotiated via HELLO: whether this peer accepts _MSGZ frames
+        self.accepts_z = accepts_z
         self._q: queue.Queue = queue.Queue(maxsize=self.QUEUE_MAX)
         self._on_dead = on_dead
         self._dead = False
@@ -269,9 +318,14 @@ class TcpTransport:
                 fresh = self._connect(endpoint)
                 if fresh is not None:
                     for k, p in retry:
+                        if k == _MSGZ and not fresh.accepts_z:
+                            # renegotiated down (peer restarted on an
+                            # older build): ship the frame uncompressed
+                            k, p = _MSG, zlib.decompress(p)
                         fresh.enqueue(k, p, attempt=1)
 
         conn = _SenderConn(sock, on_dead)
+        _start_hello_negotiation(conn)
         with self._lock:
             if self._stop.is_set():
                 # close() already ran (or is running): never insert a
@@ -290,15 +344,17 @@ class TcpTransport:
         neighbour signal, ``causal_crdt.ex:269-282``); otherwise enqueue
         on the connection's sender thread and return immediately."""
         _name, endpoint = addr
-        payload = pickle.dumps(frame[1:], protocol=4)
-        kind = frame[0]
-        if kind == _MSG and len(payload) >= _COMPRESS_MIN:
-            z = zlib.compress(payload, 1)
-            if len(z) < 0.9 * len(payload):  # keep incompressible frames raw
-                payload, kind = z, _MSGZ
         conn = self._connect(endpoint)
         if conn is None:
             return False
+        payload = pickle.dumps(frame[1:], protocol=4)
+        kind = frame[0]
+        # compression is a negotiated capability (HELLO), never assumed:
+        # a legacy peer without _FEAT_MSGZ gets plain frames
+        if kind == _MSG and conn.accepts_z and len(payload) >= _COMPRESS_MIN:
+            z = zlib.compress(payload, 1)
+            if len(z) < 0.9 * len(payload):  # keep incompressible frames raw
+                payload, kind = z, _MSGZ
         return conn.enqueue(kind, payload)
 
     @staticmethod
@@ -306,11 +362,8 @@ class TcpTransport:
         """One PING → PONG exchange on an open socket (the single wire
         handshake shared by ``alive()`` probes and heartbeats)."""
         _send_frame(sock, _PING, b"")
-        hdr = _recv_exact(sock, 4)
-        if hdr is None:
-            return False
-        body = _recv_exact(sock, _LEN.unpack(hdr)[0])
-        return body is not None and body[0] == _PONG
+        frame = _recv_frame(sock)
+        return frame is not None and frame[0] == _PONG
 
     def _ping(self, addr: tuple) -> bool:
         # connection-level liveness: a fresh short-lived connection probes
@@ -406,19 +459,22 @@ class TcpTransport:
             ).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        warned_unknown = False
         with conn:
             while not self._stop.is_set():
-                hdr = _recv_exact(conn, 4)
-                if hdr is None:
+                frame = _recv_frame(conn)
+                if frame is None:
                     return
-                n = _LEN.unpack(hdr)[0]
-                body = _recv_exact(conn, n)
-                if body is None:
-                    return
-                kind, payload = body[0], body[1:]
+                kind, payload = frame
                 if kind == _PING:
                     try:
                         _send_frame(conn, _PONG, b"")
+                    except OSError:
+                        return
+                elif kind == _HELLO:
+                    try:
+                        _send_frame(conn, _HELLO,
+                                    bytes([_WIRE_VERSION, _OUR_FEATURES]))
                     except OSError:
                         return
                 elif kind == _MSG:
@@ -427,8 +483,14 @@ class TcpTransport:
                 elif kind == _MSGZ:
                     name, msg = pickle.loads(zlib.decompress(payload))
                     self.send(name, msg)
-                else:
-                    logger.warning("dropping unknown frame kind %d (peer on a newer wire format?)", kind)
+                elif not warned_unknown:
+                    # once per connection: a misbehaving/newer peer
+                    # streaming frames must not flood the log
+                    warned_unknown = True
+                    logger.warning(
+                        "dropping unknown frame kind %d (peer on a newer "
+                        "wire format?) — further unknown frames on this "
+                        "connection are dropped silently", kind)
 
     # -- deterministic driving (parity with LocalTransport) ----------------
 

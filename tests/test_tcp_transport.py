@@ -152,3 +152,98 @@ def test_large_frames_compress_transparently():
     finally:
         a.close()
         b.close()
+
+
+def test_hello_negotiates_compression():
+    """The HELLO capability exchange flips the outbound connection to
+    compressed frames; a big frame sent after negotiation really travels
+    as _MSGZ (capability-gated — see MIGRATING.md rolling-upgrade note)."""
+    import numpy as np
+
+    from delta_crdt_ex_tpu.runtime import tcp_transport as T
+
+    a = T.TcpTransport()
+    b = T.TcpTransport()
+    sent_kinds = []
+    orig = T._send_frame
+
+    def spy(sock, kind, payload):
+        sent_kinds.append(kind)
+        return orig(sock, kind, payload)
+
+    try:
+        b.register("sink", None)
+        # first send opens the connection and fires HELLO
+        assert a.send(("sink", b.endpoint), {"tag": "opener"})
+        conn = a._conns[b.endpoint]
+        deadline = time.time() + 5
+        while not conn.accepts_z and time.time() < deadline:
+            time.sleep(0.01)
+        assert conn.accepts_z, "HELLO reply never flipped the capability"
+
+        T._send_frame = spy
+        big = {"arr": np.zeros((512, 64), np.uint64), "tag": "padded"}
+        assert a.send(("sink", b.endpoint), big)
+        got = []
+        deadline = time.time() + 10
+        while len(got) < 2 and time.time() < deadline:
+            got.extend(b.drain("sink"))
+            time.sleep(0.02)
+        assert any(m["tag"] == "padded" for m in got)
+        assert T._MSGZ in sent_kinds, "negotiated peer should get _MSGZ"
+    finally:
+        T._send_frame = orig
+        a.close()
+        b.close()
+
+
+def test_legacy_peer_never_receives_compressed_frames():
+    """A peer that does not speak HELLO (an older build) must receive
+    only plain _MSG frames — compression silently downgrading to frame
+    drops on old peers was the round-2 advisor finding."""
+    import socket as socketlib
+    import struct
+    import threading
+
+    from delta_crdt_ex_tpu.runtime import tcp_transport as T
+
+    srv = socketlib.socket(socketlib.AF_INET, socketlib.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    seen_kinds = []
+    done = threading.Event()
+
+    def legacy_server():
+        conn, _ = srv.accept()
+        with conn:
+            # read frames like an old build: parse, never answer HELLO
+            while len(seen_kinds) < 2:
+                hdr = b""
+                while len(hdr) < 4:
+                    chunk = conn.recv(4 - len(hdr))
+                    if not chunk:
+                        return
+                    hdr += chunk
+                n = struct.unpack(">I", hdr)[0]
+                body = b""
+                while len(body) < n:
+                    chunk = conn.recv(n - len(body))
+                    if not chunk:
+                        return
+                    body += chunk
+                seen_kinds.append(body[0])
+            done.set()
+
+    threading.Thread(target=legacy_server, daemon=True).start()
+    a = T.TcpTransport()
+    try:
+        import numpy as np
+
+        big = {"arr": np.zeros((512, 64), np.uint64)}
+        assert a.send(("sink", srv.getsockname()), big)
+        assert done.wait(5), f"legacy server saw only {seen_kinds}"
+        assert seen_kinds[0] == T._HELLO
+        assert seen_kinds[1] == T._MSG, "legacy peer must get plain _MSG"
+    finally:
+        a.close()
+        srv.close()
