@@ -457,6 +457,10 @@ def main():
     def _interrupted(signum, frame):
         log(f"signal {signum} received at +{budget.elapsed():.0f}s — emitting last-resort artifact")
         py = py_holder["py"]
+        # the signal may have landed mid-print of the normal line: start
+        # on a fresh line so the driver's last-line parse always sees
+        # complete JSON (a stray blank/partial line above is harmless)
+        sys.stdout.write("\n")
         _emit({
             "metric": _metric_name(fallback=True) + "_interrupted",
             "value": 0.0,

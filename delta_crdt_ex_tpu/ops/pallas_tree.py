@@ -11,16 +11,11 @@ L = 2^14 is 64 KB), so a Pallas kernel can keep the entire fold in VMEM.
 :func:`batched_roots_fn` probes and the bench uses): a roll-based
 strided fold over (8, L) blocks that computes one root per tree of a
 batch in a single launch. It is shaped around Mosaic's TPU constraints
-— 8-row blocks, full-width rolls, no reshapes.
-
-:func:`tree_from_leaves_pallas` is the round-1 packed-ALL-levels kernel
-(heap order: node i of level d at index ``2^d + i``; index 1 = root).
-Its (1, L) block spec never lowered on real TPUs (Mosaic requires the
-second-to-last block dim be a multiple of 8); it is kept as an
-interpret-mode executable spec of the packed-levels layout should a
-future sync walk want on-device levels. Both kernels' combine mix
-matches :func:`delta_crdt_ex_tpu.ops.binned.tree_from_leaves` bit for
-bit, so any implementation can serve the sync walk.
+— 8-row blocks, full-width rolls, no reshapes (a round-1 (1, L)-block
+packed-levels kernel never lowered on real TPUs and was removed; this
+one exists because of that lesson). The combine mix matches
+:func:`delta_crdt_ex_tpu.ops.binned.tree_from_leaves` bit for bit, so
+either implementation can serve the sync walk.
 """
 
 from __future__ import annotations
@@ -47,49 +42,6 @@ def _combine(left, right):
         + (_mix32(right ^ jnp.uint32(_P2)) << 1)
         + jnp.uint32(_GOLDEN)
     )
-
-
-def _tree_kernel(leaf_ref, out_ref):
-    """One grid program folds one tree entirely in VMEM.
-
-    The fold works on a [1, W] row per level (TPU wants ≥2D vectors);
-    splitting even/odd lanes via a reshape to [W/2, 2] keeps every step
-    a dense VPU op.
-    """
-    cur = leaf_ref[0, :]  # [L]
-    L = cur.shape[0]
-    w = L
-    # write packed levels progressively: level sizes L/2, L/4, …, 1
-    while w > 1:
-        pairs = cur.reshape(w // 2, 2)
-        cur = _combine(pairs[:, 0], pairs[:, 1])  # [w/2]
-        w //= 2
-        out_ref[0, w : 2 * w] = cur
-    out_ref[0, 0:1] = cur  # index 0 unused; keep deterministic
-
-
-def tree_from_leaves_pallas(leaf: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
-    """Packed parent levels ``uint32[N, L]`` for a batch of leaf arrays
-    ``uint32[N, L]`` (heap order, root at index 1). One kernel launch for
-    the whole batch; each grid program folds one tree in VMEM."""
-    from jax.experimental import pallas as pl
-
-    n, L = leaf.shape
-    return pl.pallas_call(
-        _tree_kernel,
-        out_shape=jax.ShapeDtypeStruct((n, L), jnp.uint32),
-        grid=(n,),
-        in_specs=[pl.BlockSpec((1, L), lambda i: (i, 0))],
-        out_specs=pl.BlockSpec((1, L), lambda i: (i, 0)),
-        interpret=interpret,
-    )(leaf)
-
-
-def unpack_levels(packed: jnp.ndarray, depth: int) -> list[jnp.ndarray]:
-    """Heap-packed parent levels → the list-of-levels shape the sync walk
-    consumes (root first), for ONE tree ``uint32[L]``. The leaf level is
-    not in ``packed``; append the original leaves."""
-    return [packed[(1 << d) : (1 << (d + 1))] for d in range(depth)]
 
 
 def _roots_kernel(leaf_ref, out_ref):

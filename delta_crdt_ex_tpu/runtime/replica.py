@@ -67,6 +67,33 @@ def _wire(n: int, floor: int = 8) -> int:
     return pow4_tier(n, floor)
 
 
+class _LazyLevels:
+    """Digest-tree levels, device-resident, host-materialised per level
+    on first access.
+
+    The sync walk usually terminates in the top few levels (equal trees
+    compare only the root block), so copying every level to host on each
+    state change — ~128 KB at L=2^14 — paid for readbacks the walk never
+    looked at. Indexing ``tree[level]`` now transfers just that level,
+    once, caching the numpy array for the walk's repeat visits.
+    """
+
+    __slots__ = ("_dev", "_host")
+
+    def __init__(self, levels: list) -> None:
+        self._dev = levels
+        self._host: list[np.ndarray | None] = [None] * len(levels)
+
+    def __len__(self) -> int:
+        return len(self._dev)
+
+    def __getitem__(self, level: int) -> np.ndarray:
+        h = self._host[level]
+        if h is None:
+            h = self._host[level] = np.asarray(self._dev[level])
+        return h
+
+
 class Replica:
     def __init__(
         self,
@@ -89,6 +116,7 @@ class Replica:
         checkpoint_interval: float = 5.0,
         eager_deltas: bool = True,
         gc_interval_ops: int = 4096,
+        device=None,
     ):
         # max_sync_size validation (reference raises, causal_crdt.ex:52-62)
         if max_sync_size == "infinite":
@@ -144,7 +172,7 @@ class Replica:
         self._neighbours: list[Any] = []
         self._monitors: set[Any] = set()
         self._outstanding: dict[Any, int] = {}
-        self._tree: list[np.ndarray] | None = None
+        self._tree: _LazyLevels | None = None
         self._read_cache: dict | None = None
         self._seq = 0
         self._stop = threading.Event()
@@ -155,6 +183,13 @@ class Replica:
         # transport's canonical (routable-from-anywhere) address — the
         # {name, node} analog (causal_crdt_test.exs:68-78)
         self.addr = self.transport.canonical_addr(self.name)
+
+        #: jax device this replica's state is pinned to (None = default
+        #: placement). Peer replicas pinned to devices of one mesh get
+        #: their sync slices moved device↔device (ICI on real hardware)
+        #: instead of through host pickle — SURVEY §5.8's hybrid: host
+        #: control plane, device data plane.
+        self.device = device
 
         snap = storage_module.read(self.name) if storage_module else None
         if snap is not None:
@@ -169,6 +204,12 @@ class Replica:
             )
             self.state = state
             self.self_slot = 0
+        if device is not None:
+            import jax
+
+            # commit the state to the device: every jitted kernel over it
+            # then runs (and allocates its outputs) there
+            self.state = jax.device_put(self.state, device)
 
         self.transport.register(self.name, self)
         self._warmup()
@@ -634,10 +675,9 @@ class Replica:
     # ------------------------------------------------------------------
     # anti-entropy (reference causal_crdt.ex:252-335)
 
-    def _ensure_tree(self) -> list[np.ndarray]:
+    def _ensure_tree(self) -> "_LazyLevels":
         if self._tree is None:
-            levels = self.model.tree_from_leaves(self.state.leaf)
-            self._tree = [np.asarray(l) for l in levels]
+            self._tree = _LazyLevels(self.model.tree_from_leaves(self.state.leaf))
         return self._tree
 
     def sync_to_all(self) -> None:
@@ -714,7 +754,9 @@ class Replica:
                 jnp.uint64(self.node_id),
                 jnp.asarray(lo),
             )
-            arrays, payloads = self._slice_wire(sl, rows)
+            arrays, payloads = self._slice_wire(
+                sl, rows, self._common_device([n for n, _cur in members])
+            )
             for n, cur in members:
                 msg = sync_proto.EntriesMsg(
                     originator=self.addr,
@@ -747,7 +789,7 @@ class Replica:
             rows = np.full(_wire(max(len(pend), 1)), -1, np.int32)
             rows[: len(pend)] = pend
             sl = self.model.extract_rows(self.state, jnp.asarray(rows))
-            arrays, payloads = self._slice_wire(sl, rows)
+            arrays, payloads = self._slice_wire(sl, rows, self._common_device(members))
             for n in members:
                 msg = sync_proto.EntriesMsg(
                     originator=self.addr,
@@ -831,31 +873,72 @@ class Replica:
         self._send_entries(to=msg.frm, buckets=msg.buckets, originator=msg.originator)
         self._outstanding.pop(msg.frm, None)
 
-    def _slice_wire(self, sl, rows: np.ndarray) -> tuple[dict, dict]:
-        """Serialise a RowSlice to the EntriesMsg wire format: the numpy
+    def _slice_wire(self, sl, rows: np.ndarray, target_device=None) -> tuple[dict, dict]:
+        """Serialise a RowSlice to the EntriesMsg wire format: the slice
         column arrays (context rows for exactly the shipped buckets —
         bucket-atomic sync: coverage never outruns content) plus the
-        payload dict of every alive dot in the slice."""
-        arrays = {c: np.asarray(getattr(sl, c)) for c in _SLICE_COLUMNS}
-        arrays["rows"] = rows
-        arrays["ctx_rows"] = np.asarray(sl.ctx_rows)
-        arrays["ctx_lo"] = np.asarray(sl.ctx_lo)
-        arrays["ctx_gid"] = np.asarray(sl.ctx_gid)
-        # vectorized dot gather: one numpy pass + a batched tolist beats
-        # per-entry scalar indexing ~10x on big slices (VERDICT r2 weak #4)
-        u_idx, b_idx = np.nonzero(arrays["alive"])
-        gid_l = arrays["ctx_gid"][arrays["node"][u_idx, b_idx]].tolist()
+        payload dict of every alive dot in the slice.
+
+        Two data planes (SURVEY §5.8 hybrid):
+
+        - ``target_device=None`` — host plane: columns become numpy
+          (pickleable for cross-host transports).
+        - ``target_device=<jax device>`` — device plane: columns are
+          placed directly on the receiver's device (``jax.device_put``
+          rides ICI between chips; a same-device put is free), never
+          round-tripping through host buffers. The payload dict is host
+          data either way (arbitrary Python terms live off-device), and
+          building it needs host views of node/ctr/alive — small columns;
+          the wide key/ts columns stay on device.
+        """
+        # host gathers for the payload dict (needed on either plane) —
+        # one numpy pass + a batched tolist beats per-entry scalar
+        # indexing ~10x on big slices (VERDICT r2 weak #4)
+        node_h = np.asarray(sl.node)
+        ctr_h = np.asarray(sl.ctr)
+        alive_h = np.asarray(sl.alive)
+        gid_h = np.asarray(sl.ctx_gid)
+        u_idx, b_idx = np.nonzero(alive_h)
+        gid_l = gid_h[node_h[u_idx, b_idx]].tolist()
         row_l = rows[u_idx].tolist()
-        ctr_l = arrays["ctr"][u_idx, b_idx].tolist()
+        ctr_l = ctr_h[u_idx, b_idx].tolist()
         pay = self._payloads
         payloads = {dot: pay[dot] for dot in zip(gid_l, row_l, ctr_l)}
+
+        if target_device is None:
+            arrays = {c: np.asarray(getattr(sl, c)) for c in _SLICE_COLUMNS}
+            arrays["ctx_rows"] = np.asarray(sl.ctx_rows)
+            arrays["ctx_lo"] = np.asarray(sl.ctx_lo)
+            arrays["ctx_gid"] = gid_h
+        else:
+            import jax
+
+            put = lambda x: jax.device_put(x, target_device)  # noqa: E731
+            arrays = {c: put(getattr(sl, c)) for c in _SLICE_COLUMNS}
+            arrays["ctx_rows"] = put(sl.ctx_rows)
+            arrays["ctx_lo"] = put(sl.ctx_lo)
+            arrays["ctx_gid"] = put(sl.ctx_gid)
+        arrays["rows"] = rows  # row indices are control metadata: numpy
         return arrays, payloads
+
+    def _common_device(self, peers) -> "Any | None":
+        """The single device shared by every peer in ``peers`` (their
+        registered replicas' pinned devices), or None if any is unpinned
+        or they differ — a fanned-out message body is built once, so the
+        device plane applies only when one placement serves the group."""
+        dev = None
+        for n in peers:
+            d = getattr(self.transport, "device_of", lambda _n: None)(n)
+            if d is None or (dev is not None and d != dev):
+                return None
+            dev = d
+        return dev
 
     def _send_entries(self, to, buckets: np.ndarray, originator) -> bool:
         rows = np.full(_wire(max(len(buckets), 1)), -1, np.int32)
         rows[: len(buckets)] = np.asarray(buckets, np.int32)
         sl = self.model.extract_rows(self.state, jnp.asarray(rows))
-        arrays, payloads = self._slice_wire(sl, rows)
+        arrays, payloads = self._slice_wire(sl, rows, self._common_device([to]))
         return self.transport.send(
             to,
             sync_proto.EntriesMsg(
@@ -950,7 +1033,10 @@ class Replica:
             {
                 "duration_s": time.perf_counter() - t0,
                 "buckets": int(len(msg.buckets)),
-                "entries": int(np.sum(a["alive"])),
+                # .sum() runs wherever the column lives: numpy on host
+                # (host plane), device reduction + scalar readback
+                # (device plane) — no cross-plane transfer either way
+                "entries": int(a["alive"].sum()),
             },
             {"name": self.name},
         )

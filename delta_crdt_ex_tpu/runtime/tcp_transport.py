@@ -269,6 +269,16 @@ class TcpTransport:
         with self._lock:
             return addr in self._owners
 
+    def device_of(self, addr: Hashable):
+        """Device data plane applies only to same-process peers: remote
+        frames must serialise, so cross-host slices stay on the host
+        plane (pickled + compressed) and the mesh SPMD path covers
+        device↔device movement across hosts."""
+        if self._is_remote(addr):
+            return None
+        with self._lock:
+            return getattr(self._owners.get(self._local_name(addr)), "device", None)
+
     def _local_name(self, addr):
         # a remote-style address pointing at ourselves resolves locally
         if isinstance(addr, tuple) and len(addr) == 2 and addr[1] == self.endpoint:

@@ -66,6 +66,15 @@ class LocalTransport:
         with self._lock:
             return addr in self._owners
 
+    def device_of(self, addr: Hashable):
+        """The jax device the replica behind ``addr`` pinned its state to
+        (None when unpinned or unknown). Senders use this to place sync
+        slices directly on the receiver's device — the device data plane;
+        in-process messages pass by reference, so a device-resident array
+        in an EntriesMsg never takes a host round trip."""
+        with self._lock:
+            return getattr(self._owners.get(addr), "device", None)
+
     def send(self, addr: Hashable, msg: Any) -> bool:
         with self._lock:
             mb = self._mailboxes.get(addr)

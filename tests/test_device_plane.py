@@ -1,0 +1,142 @@
+"""Device data plane for the anti-entropy runtime (SURVEY §5.8 hybrid).
+
+When peer replicas pin their states to devices of one mesh, sync slices
+travel device↔device (``jax.device_put`` onto the receiver's device —
+ICI on real hardware) while the control plane (messages, payload dicts)
+stays on host. Unpinned or cross-host peers keep the host plane. Runs
+on the 8-virtual-CPU-device mesh from conftest.
+"""
+
+import jax
+import numpy as np
+
+from delta_crdt_ex_tpu import AWLWWMap
+from delta_crdt_ex_tpu.api import start_link
+from delta_crdt_ex_tpu.runtime import sync as sync_proto
+from delta_crdt_ex_tpu.runtime.transport import LocalTransport
+from tests.conftest import converge
+
+
+def _mk(transport, clock, **opts):
+    opts.setdefault("capacity", 64)
+    opts.setdefault("tree_depth", 6)
+    return start_link(
+        AWLWWMap, threaded=False, transport=transport, clock=clock, **opts
+    )
+
+
+def _capture_entries(transport):
+    captured = []
+    orig = transport.send
+
+    def send(addr, msg):
+        if isinstance(msg, sync_proto.EntriesMsg):
+            captured.append(msg)
+        return orig(addr, msg)
+
+    transport.send = send
+    return captured
+
+
+def test_pinned_peers_sync_device_to_device(transport, shared_clock):
+    d0, d1 = jax.devices()[:2]
+    a = _mk(transport, shared_clock, device=d0)
+    b = _mk(transport, shared_clock, device=d1)
+    a.set_neighbours([b])
+    captured = _capture_entries(transport)
+
+    a.mutate("add", ["k", "v"])
+    converge(transport, [a, b])
+    assert b.read() == {"k": "v"}
+
+    assert captured, "no entries message crossed the transport"
+    for msg in captured:
+        key_col = msg.arrays["key"]
+        assert isinstance(key_col, jax.Array), type(key_col)
+        assert key_col.devices() == {d1}, "slice not placed on receiver device"
+        # row indices are control metadata and stay host-side
+        assert isinstance(msg.arrays["rows"], np.ndarray)
+    # the receiver's merged state lives where it was pinned
+    assert b.state.leaf.devices() == {d1}
+
+
+def test_unpinned_receiver_uses_host_plane(transport, shared_clock):
+    d0 = jax.devices()[0]
+    a = _mk(transport, shared_clock, device=d0)
+    b = _mk(transport, shared_clock)  # unpinned
+    a.set_neighbours([b])
+    captured = _capture_entries(transport)
+
+    a.mutate("add", ["k", "v"])
+    converge(transport, [a, b])
+    assert b.read() == {"k": "v"}
+    assert captured
+    for msg in captured:
+        assert isinstance(msg.arrays["key"], np.ndarray), type(msg.arrays["key"])
+
+
+def test_mixed_device_fanout_falls_back_to_host_plane(transport, shared_clock):
+    """A fanned-out push builds one message body for all equal-cursor
+    neighbours; peers pinned to different devices can't share one
+    placement, so the group ships host-plane — and still converges."""
+    devs = jax.devices()
+    a = _mk(transport, shared_clock, device=devs[0])
+    b = _mk(transport, shared_clock, device=devs[1])
+    c = _mk(transport, shared_clock, device=devs[2])
+    a.set_neighbours([b, c])
+    captured = _capture_entries(transport)
+
+    a.mutate("add", ["k", "v"])
+    converge(transport, [a, b, c])
+    assert b.read() == {"k": "v"}
+    assert c.read() == {"k": "v"}
+    assert captured
+    for msg in captured:
+        assert isinstance(msg.arrays["key"], np.ndarray)
+
+
+def test_walk_repair_path_rides_device_plane(transport, shared_clock):
+    """The digest-walk repair transfer (_send_entries via GetDiff) is
+    single-target, so it uses the receiver's device even when eager
+    pushes are off — the device plane is not an eager-push special."""
+    d0, d1 = jax.devices()[:2]
+    a = _mk(transport, shared_clock, device=d0, eager_deltas=False)
+    b = _mk(transport, shared_clock, device=d1, eager_deltas=False)
+    a.set_neighbours([b])
+    captured = _capture_entries(transport)
+
+    for i in range(8):
+        a.mutate("add", [f"k{i}", i])
+    converge(transport, [a, b])
+    assert b.read() == {f"k{i}": i for i in range(8)}
+    assert captured
+    for msg in captured:
+        assert isinstance(msg.arrays["key"], jax.Array)
+        assert msg.arrays["key"].devices() == {d1}
+
+
+def test_device_pinned_pair_full_protocol_soak(shared_clock):
+    """Partition/heal + removes over pinned replicas: the device plane
+    must not change any protocol outcome (same assertions as the host-
+    plane replica tests)."""
+    transport = LocalTransport()
+    d0, d1 = jax.devices()[:2]
+    a = _mk(transport, shared_clock, device=d0)
+    b = _mk(transport, shared_clock, device=d1)
+    a.set_neighbours([b])
+    b.set_neighbours([a])
+
+    for i in range(20):
+        a.mutate("add", [f"k{i}", i])
+    converge(transport, [a, b])
+    assert b.read() == {f"k{i}": i for i in range(20)}
+
+    # partition: b writes alone, then heal
+    a.set_neighbours([])
+    b.mutate("remove", ["k0"])
+    b.mutate("add", ["k1", "overwritten"])
+    a.set_neighbours([b])
+    converge(transport, [a, b])
+    want = {f"k{i}": i for i in range(2, 20)} | {"k1": "overwritten"}
+    assert a.read() == want
+    assert b.read() == want

@@ -1,4 +1,4 @@
-"""Pallas digest-tree kernel vs the XLA reference implementation.
+"""Pallas digest-tree roots kernel vs the XLA reference implementation.
 
 Runs the kernel in interpreter mode on CPU (Pallas TPU lowering needs
 real hardware); bit-for-bit equality with ``ops.binned.tree_from_leaves``
@@ -9,25 +9,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from delta_crdt_ex_tpu.ops.binned import tree_from_leaves
-from delta_crdt_ex_tpu.ops.pallas_tree import (
-    batched_roots_pallas,
-    tree_from_leaves_pallas,
-    unpack_levels,
-)
-
-
-def test_pallas_tree_matches_xla_levels():
-    rng = np.random.default_rng(0)
-    L = 256
-    leaves = jnp.asarray(rng.integers(0, 1 << 32, size=(3, L), dtype=np.uint32))
-    packed = tree_from_leaves_pallas(leaves, interpret=True)
-    depth = L.bit_length() - 1
-    for i in range(3):
-        want = tree_from_leaves(leaves[i])  # root first, leaf last
-        got = unpack_levels(packed[i], depth) + [leaves[i]]
-        assert len(got) == len(want)
-        for lw, lg in zip(want, got):
-            assert np.array_equal(np.asarray(lw), np.asarray(lg))
+from delta_crdt_ex_tpu.ops.pallas_tree import batched_roots_pallas
 
 
 def test_pallas_roots_matches_xla():
@@ -42,9 +24,11 @@ def test_pallas_roots_matches_xla():
         assert [int(x) for x in got] == want
 
 
-def test_pallas_tree_distinguishes_sibling_order():
-    a = jnp.zeros((1, 64), jnp.uint32).at[0, 0].set(7)
-    b = jnp.zeros((1, 64), jnp.uint32).at[0, 1].set(7)
-    pa = tree_from_leaves_pallas(a, interpret=True)
-    pb = tree_from_leaves_pallas(b, interpret=True)
-    assert int(pa[0, 1]) != int(pb[0, 1])  # roots differ
+def test_pallas_roots_distinguish_sibling_order():
+    """The combine is position-dependent: swapping two sibling leaves
+    must change the root (a symmetric combine would miss reorderings)."""
+    a = jnp.zeros((1, 128), jnp.uint32).at[0, 0].set(7)
+    b = jnp.zeros((1, 128), jnp.uint32).at[0, 1].set(7)
+    ra = batched_roots_pallas(a, interpret=True)
+    rb = batched_roots_pallas(b, interpret=True)
+    assert int(ra[0]) != int(rb[0])
