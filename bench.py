@@ -452,17 +452,24 @@ def main():
     fallback_reserve = float(os.environ.get("BENCH_FALLBACK_RESERVE", "480"))
     if os.environ.get("BENCH_NO_CPU_FALLBACK") == "1":
         fallback_reserve = 0.0
-    py_holder = {"py": None}
+    # shared run state: the py baseline (for the last-resort line) and
+    # whether the run was in its CPU-fallback leg — failure labels must
+    # name the backend that was actually executing, not assume CPU
+    run_state = {"py": None, "fallback": os.environ.get("BENCH_FORCED_CPU") == "1"}
 
     def _interrupted(signum, frame):
+        if _EMITTED:
+            # the artifact already went out whole — do not append even a
+            # newline (tail -1 must keep finding the real line)
+            raise SystemExit(1)
         log(f"signal {signum} received at +{budget.elapsed():.0f}s — emitting last-resort artifact")
-        py = py_holder["py"]
+        py = run_state["py"]
         # the signal may have landed mid-print of the normal line: start
         # on a fresh line so the driver's last-line parse always sees
         # complete JSON (a stray blank/partial line above is harmless)
         sys.stdout.write("\n")
         _emit({
-            "metric": _metric_name(fallback=True) + "_interrupted",
+            "metric": _metric_name(run_state["fallback"]) + "_interrupted",
             "value": 0.0,
             "unit": "merges/sec",
             "vs_baseline": 0.0,
@@ -476,7 +483,7 @@ def main():
     signal.signal(signal.SIGINT, _interrupted)
 
     try:
-        _main_measured(budget, fallback_reserve, py_holder)
+        _main_measured(budget, fallback_reserve, run_state)
     except BaseException as e:  # noqa: BLE001 — artifact guarantee
         import traceback
 
@@ -484,7 +491,7 @@ def main():
         if not _EMITTED:
             log(f"bench failed without artifact: {e!r} — emitting error line")
             _emit({
-                "metric": _metric_name(fallback=True) + "_failed",
+                "metric": _metric_name(run_state["fallback"]) + "_failed",
                 "value": 0.0,
                 "unit": "merges/sec",
                 "vs_baseline": 0.0,
@@ -495,14 +502,14 @@ def main():
         raise SystemExit(0) from e
 
 
-def _main_measured(budget: Budget, fallback_reserve: float, py_holder: dict):
+def _main_measured(budget: Budget, fallback_reserve: float, run_state: dict):
     log(
         f"workload: {N_KEYS} keys, {NEIGHBOURS} neighbours, {DELTA}-entry "
         f"delta-interval slices, L=2^{TREE_DEPTH} buckets; "
         f"budget {budget.total:.0f}s (fallback reserve {fallback_reserve:.0f}s)"
     )
     py = bench_python()
-    py_holder["py"] = py
+    run_state["py"] = py
 
     # a wedged claim (killed holder's grant) can take tens of minutes to
     # expire — probe patiently, but only within the shared budget
@@ -536,6 +543,7 @@ def _main_measured(budget: Budget, fallback_reserve: float, py_holder: dict):
         # loud, labelled CPU fallback: the artifact must never silently
         # pass off a CPU number as the accelerator result
         fallback = True
+        run_state["fallback"] = True
         log(f"falling back to CPU at +{budget.elapsed():.0f}s "
             f"({budget.remaining():.0f}s left; metric labelled _cpu_fallback)")
         env = dict(os.environ)

@@ -906,7 +906,13 @@ class Replica:
         payloads = {dot: pay[dot] for dot in zip(gid_l, row_l, ctr_l)}
 
         if target_device is None:
-            arrays = {c: np.asarray(getattr(sl, c)) for c in _SLICE_COLUMNS}
+            # reuse the host copies the payload build already made —
+            # node/ctr/alive must not pay a second device→host transfer
+            host = {"node": node_h, "ctr": ctr_h, "alive": alive_h}
+            arrays = {
+                c: host.get(c) if c in host else np.asarray(getattr(sl, c))
+                for c in _SLICE_COLUMNS
+            }
             arrays["ctx_rows"] = np.asarray(sl.ctx_rows)
             arrays["ctx_lo"] = np.asarray(sl.ctx_lo)
             arrays["ctx_gid"] = gid_h
