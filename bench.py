@@ -521,8 +521,9 @@ def _main_measured(budget: Budget, fallback_reserve: float, run_state: dict):
     child_floor = 240.0
 
     res = None
-    fallback = os.environ.get("BENCH_FORCED_CPU") == "1"
-    if not fallback and _device_backend_usable(
+    # run_state["fallback"] is the single source of truth for which
+    # backend is executing — the failure labels in main() read it live
+    if not run_state["fallback"] and _device_backend_usable(
         budget, fallback_reserve + child_floor, claim_timeout, claim_attempts
     ):
         env = dict(os.environ)
@@ -542,7 +543,6 @@ def _main_measured(budget: Budget, fallback_reserve: float, run_state: dict):
     if res is None:
         # loud, labelled CPU fallback: the artifact must never silently
         # pass off a CPU number as the accelerator result
-        fallback = True
         run_state["fallback"] = True
         log(f"falling back to CPU at +{budget.elapsed():.0f}s "
             f"({budget.remaining():.0f}s left; metric labelled _cpu_fallback)")
@@ -574,7 +574,7 @@ def _main_measured(budget: Budget, fallback_reserve: float, run_state: dict):
 
     value = float(res["merges_per_sec"])
     line = {
-        "metric": _metric_name(fallback),
+        "metric": _metric_name(run_state["fallback"]),
         "value": round(value, 2),
         "unit": "merges/sec",
         "vs_baseline": round(value / py, 3),

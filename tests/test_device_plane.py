@@ -204,3 +204,22 @@ def test_adversarial_schedule_device_pinned(shared_clock):
         if all(r.read() == want for r in rs):
             break
     assert all(r.read() == want for r in rs)
+
+
+def test_rehydrate_repins_state_to_device(transport, shared_clock):
+    """Crash-rehydrate must land the restored state back on the pinned
+    device (the device_put runs after either init branch), preserving
+    node-id continuity as usual."""
+    from delta_crdt_ex_tpu.runtime.storage import MemoryStorage
+
+    d1 = jax.devices()[1]
+    st = MemoryStorage()
+    a = _mk(transport, shared_clock, name="pinned", storage_module=st, device=d1)
+    a.mutate("add", ["k", "v"])
+    nid = a.node_id
+    transport.unregister(a.name)  # crash without stop()
+
+    b = _mk(transport, shared_clock, name="pinned", storage_module=st, device=d1)
+    assert b.node_id == nid
+    assert b.read() == {"k": "v"}
+    assert b.state.leaf.devices() == {d1}
