@@ -24,7 +24,7 @@ from delta_crdt_ex_tpu.runtime.transport import LocalTransport
 from tests.conftest import converge
 
 
-def _mk(transport, clock, name, storage):
+def _mk(transport, clock, name, storage, device=None):
     return start_link(
         AWLWWMap,
         threaded=False,
@@ -34,16 +34,32 @@ def _mk(transport, clock, name, storage):
         tree_depth=6,
         name=name,
         storage_module=storage,
+        device=device,
     )
 
 
-def _run_soak(n_replicas: int, n_ops: int, seed: int):
+def _soak_device(i: int, pin: bool):
+    """Device assignment for replica i: pinned soaks alternate pinned/
+    unpinned replicas over just TWO devices, so at >=5 replicas every
+    plane pairing sees churn — pinned->pinned on the SAME device (the
+    free-put fast path), pinned->pinned cross-device, and
+    pinned<->unpinned (host-plane fallback)."""
+    if not pin or i % 2:
+        return None
+    import jax
+
+    devs = jax.devices()
+    return devs[(i // 2) % min(2, len(devs))]
+
+
+def _run_soak(n_replicas: int, n_ops: int, seed: int, pin_devices: bool = False):
     rng = np.random.default_rng(seed)
     transport = LocalTransport()
     clock = LogicalClock()
     storage = MemoryStorage()
     reps = [
-        _mk(transport, clock, f"soak{seed}-{i}", storage) for i in range(n_replicas)
+        _mk(transport, clock, f"soak{seed}-{i}", storage, _soak_device(i, pin_devices))
+        for i in range(n_replicas)
     ]
 
     def rewire(partition: set[int]):
@@ -101,7 +117,10 @@ def _run_soak(n_replicas: int, n_ops: int, seed: int):
                 victim = int(rng.integers(0, n_replicas))
                 name = reps[victim].name
                 transport.unregister(reps[victim].addr)
-                reps[victim] = _mk(transport, clock, name, storage)
+                reps[victim] = _mk(
+                    transport, clock, name, storage,
+                    _soak_device(victim, pin_devices),
+                )
                 rewire(partitioned)
 
             # under partition the sides diverge; only assert on full heals.
@@ -133,8 +152,16 @@ def test_soak_miniature():
     _run_soak(3, 40, seed=11)
 
 
+def test_soak_miniature_device_pinned():
+    """Same hazards with half the replicas pinned to mesh devices: the
+    device data plane must survive partitions, crash-rehydrate (which
+    re-pins), and mixed-plane fan-out."""
+    _run_soak(3, 40, seed=12, pin_devices=True)
+
+
 @pytest.mark.skipif(os.environ.get("RUN_SOAK") != "1", reason="set RUN_SOAK=1")
-@pytest.mark.parametrize("seed", [1, 2, 3])
-def test_soak_full(seed):
-    """Full soak: 6 replicas, 250 ops per seed, every hazard enabled."""
-    _run_soak(6, 250, seed=seed)
+@pytest.mark.parametrize("seed,pin", [(1, False), (2, False), (3, False), (4, True)])
+def test_soak_full(seed, pin):
+    """Full soak: 6 replicas, 250 ops per seed, every hazard enabled
+    (seed 4 runs with half the replicas device-pinned)."""
+    _run_soak(6, 250, seed=seed, pin_devices=pin)
