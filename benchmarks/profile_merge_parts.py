@@ -153,6 +153,49 @@ def main():
 
     log(f"argsort 8192 x64: {timed(lambda: f_sort(sl))*1e3:.1f} ms")
 
+    # gather-packing probe (mirror of the scatter probe): merge_slice's
+    # compacted branch pays 6 per-column take() gathers at the same
+    # indices. If TPU gather cost is per index entry (payload-width
+    # free), one stacked [E, 7]-plane gather should win ~6x; on CPU the
+    # plane concatenate makes it LOSE (measured 12.5 vs 21.3 ms) — chip
+    # numbers decide whether the kernel change is worth it.
+    # mirror _gather_rows/_ROW_COLS: 6 per-column gathers (key u64,
+    # ts i64, valh, node, ctr, ehash) = 8 u32 planes
+    g_idx = jnp.asarray(np.sort(rng.choice(L * B, size=E, replace=False)).astype(np.int32))
+    ck = jnp.asarray(rng.integers(0, 1 << 63, (NEIGHBOURS, L * B), np.uint64))
+    cts = jnp.asarray(rng.integers(0, 1 << 62, (NEIGHBOURS, L * B), np.int64))
+    c32 = [jnp.asarray(rng.integers(0, 1 << 32, (NEIGHBOURS, L * B), np.uint32)) for _ in range(4)]
+
+    @jax.jit
+    def f_gather_scalar(ck, cts, c32):
+        f = lambda a: a[:, g_idx]
+        return (f(ck), f(cts)) + tuple(f(c) for c in c32)
+
+    log(
+        f"6 scalar gathers @ {E} idx x64: "
+        f"{timed(lambda: f_gather_scalar(ck, cts, c32))*1e3:.1f} ms"
+    )
+
+    @jax.jit
+    def f_gather_stacked(ck, cts, c32):
+        planes = jnp.concatenate(
+            [jax.lax.bitcast_convert_type(ck, jnp.uint32),
+             jax.lax.bitcast_convert_type(cts, jnp.uint32)]
+            + [c[..., None] for c in c32],
+            axis=2,
+        )  # [N, L*B, 8]
+        g = planes[:, g_idx, :]
+        return (
+            jax.lax.bitcast_convert_type(g[..., 0:2], jnp.uint64),
+            jax.lax.bitcast_convert_type(g[..., 2:4], jnp.int64),
+            g[..., 4], g[..., 5], g[..., 6], g[..., 7],
+        )
+
+    log(
+        f"1 stacked [E,8] gather @ {E} idx x64: "
+        f"{timed(lambda: f_gather_stacked(ck, cts, c32))*1e3:.1f} ms"
+    )
+
     # gather whole rows x64 (merge_rows' main memory traffic)
     @jax.jit
     def f_gather(states, s):

@@ -8,10 +8,17 @@ replicas (reference ``propagation.exs:61-64``). Measure: wall-clock for
 sync threads at ``sync_interval`` 5 ms (reference ``:38-44``).
 
 Run: ``python -m benchmarks.propagation [N ...]``  (default 20000 30000)
+
+``PROP_DEVICE_PLANE=1`` pins both replicas to the first jax device, so
+sync slices ride the device data plane (on one real chip: same-device
+puts — slice columns never take the host round trip). The emitted
+result rows gain a ``@dev`` suffix so the two planes never mix in the
+results file.
 """
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
@@ -20,14 +27,26 @@ from delta_crdt_ex_tpu.api import start_link
 from delta_crdt_ex_tpu.runtime.transport import LocalTransport
 from benchmarks.common import BenchRecorder, emit, log
 
+DEVICE_PLANE = os.environ.get("PROP_DEVICE_PLANE") == "1"
+
+
+def _pin_device():
+    if not DEVICE_PLANE:
+        return None
+    import jax
+
+    return jax.devices()[0]
+
 
 def prepare(number):
     transport = LocalTransport()
     rec = BenchRecorder()
+    dev = _pin_device()
     c1 = start_link(AWLWWMap, transport=transport, sync_interval=0.005,
-                    capacity=max(4096, 4 * number), tree_depth=12, max_sync_size=500)
+                    capacity=max(4096, 4 * number), tree_depth=12, max_sync_size=500,
+                    device=dev)
     c2 = start_link(AWLWWMap, transport=transport, sync_interval=0.005,
-                    on_diffs=rec.on_diffs,
+                    on_diffs=rec.on_diffs, device=dev,
                     capacity=max(4096, 4 * number), tree_depth=12, max_sync_size=500)
     c1.set_neighbours([c2])
     c2.set_neighbours([c1])
@@ -79,13 +98,16 @@ def perform(pair, op):
 
 def main(sizes=(20_000, 30_000)):
     results = {}
+    tag = "@dev" if DEVICE_PLANE else ""
     for n in sizes:
         for op in ("add", "remove"):
-            log(f"preparing {n}-key pair for {op}…")
+            log(f"preparing {n}-key pair for {op}{tag}…")
             dt = perform(prepare(n), op)
-            results[f"{op}10@{n}"] = round(dt * 1000, 2)
-            log(f"{op} 10 into {n}-key pair: {dt*1000:.1f} ms")
-    emit("propagation", results)
+            results[f"{op}10@{n}{tag}"] = round(dt * 1000, 2)
+            log(f"{op} 10 into {n}-key pair{tag}: {dt*1000:.1f} ms")
+    # separate results file per plane — emit() rewrites whole files, and
+    # a device-plane run must not clobber the host-plane rows
+    emit("propagation_devplane" if DEVICE_PLANE else "propagation", results)
     return results
 
 

@@ -1044,7 +1044,13 @@ class Replica:
                 # (device plane) — no cross-plane transfer either way
                 "entries": int(a["alive"].sum()),
             },
-            {"name": self.name},
+            {
+                "name": self.name,
+                # which data plane carried the slice (observability for
+                # mixed-plane clusters); metadata, not measurements —
+                # measurements stay numeric/aggregatable
+                "plane": "host" if isinstance(a["key"], np.ndarray) else "device",
+            },
         )
         self._persist()
         # received payloads stick in the host dict even when the merge

@@ -15,7 +15,7 @@ from typing import Callable
 
 SYNC_DONE = ("delta_crdt", "sync", "done")  # measurements: keys_updated_count
 CAPACITY_GROWN = ("delta_crdt", "capacity", "grown")  # measurements: capacity, replica_capacity
-SYNC_ROUND = ("delta_crdt", "sync", "round")  # measurements: duration_s, buckets, entries
+SYNC_ROUND = ("delta_crdt", "sync", "round")  # measurements: duration_s, buckets, entries; metadata: name, plane
 
 _lock = threading.Lock()
 _handlers: dict[tuple, list[Callable]] = defaultdict(list)

@@ -223,3 +223,28 @@ def test_rehydrate_repins_state_to_device(transport, shared_clock):
     assert b.node_id == nid
     assert b.read() == {"k": "v"}
     assert b.state.leaf.devices() == {d1}
+
+
+def test_sync_round_telemetry_reports_plane(transport, shared_clock):
+    """SYNC_ROUND telemetry names the data plane that carried each
+    merged slice — device for pinned peers, host otherwise."""
+    from delta_crdt_ex_tpu.runtime import telemetry
+
+    d0, d1 = jax.devices()[:2]
+    planes = []
+    rec = lambda event, meas, meta: planes.append(meta["plane"])
+    telemetry.attach(telemetry.SYNC_ROUND, rec)
+    try:
+        a = _mk(transport, shared_clock, device=d0)
+        b = _mk(transport, shared_clock, device=d1)
+        c = _mk(transport, shared_clock)  # unpinned
+        a.set_neighbours([b])
+        a.mutate("add", ["k", 1])
+        converge(transport, [a, b])
+        assert "device" in planes and "host" not in planes, planes
+        a.set_neighbours([c])
+        a.mutate("add", ["k2", 2])
+        converge(transport, [a, c])
+        assert "host" in planes, planes
+    finally:
+        telemetry.detach(telemetry.SYNC_ROUND, rec)
