@@ -12,9 +12,21 @@ Run: ``python -m benchmarks.mesh_gossip``
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 
 import numpy as np
+
+if os.environ.get("JAX_PLATFORMS", "") in ("", "cpu"):
+    # a bare-CPU invocation would otherwise measure a 1-device "ring"
+    # (trivial steps, heal in 2) and quietly record nonsense. Must run
+    # before importing benchmarks.common, whose compilation-cache setup
+    # initialises the backend (the host device count parses only once).
+    # Never force when an accelerator platform is pinned — the TPU
+    # matrix must measure the chip mesh or fail the n>1 assert loudly.
+    from delta_crdt_ex_tpu.utils.devices import force_cpu_devices
+
+    force_cpu_devices(8)
 
 from benchmarks.common import emit, log
 
@@ -33,6 +45,10 @@ def main():
     )
 
     n = len(jax.devices())
+    assert n > 1, (
+        "mesh_gossip needs a multi-device mesh; got 1 device — set "
+        "XLA_FLAGS=--xla_force_host_platform_device_count=N for CPU runs"
+    )
     mesh = make_mesh()
     log(f"mesh: {n} devices ({jax.default_backend()})")
 

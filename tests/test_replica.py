@@ -515,3 +515,27 @@ def test_host_dicts_bounded_under_churn(transport, shared_clock):
     want = {f"k{i}": 29 for i in range(live_keys // 2, live_keys)}
     assert a.read() == want
     assert b.read() == want
+
+
+def test_mass_remove_wave_prunes_receiver_dicts(transport, shared_clock):
+    """A remove wave reaches the receiver as kills with near-zero
+    payloads; kills must count as gc pressure, or the receiver's host
+    dicts sit at peak size until unrelated inserts arrive."""
+    a = mk(transport, shared_clock, gc_interval_ops=64, capacity=1024, tree_depth=8)
+    b = mk(transport, shared_clock, gc_interval_ops=64, capacity=1024, tree_depth=8)
+    a.set_neighbours([b])
+    for i in range(300):
+        a.mutate("add", [f"k{i}", i])
+    converge(transport, [a, b])
+    assert len(b.read()) == 300
+    peak = len(b._payloads)
+    assert peak >= 300
+
+    for i in range(280):
+        a.mutate("remove", [f"k{i}"])
+    converge(transport, [a, b])
+    want = {f"k{i}": i for i in range(280, 300)}
+    assert b.read() == want
+    # kills pressured gc on the receiver: dict well below peak, bounded
+    # by live + the pre-gc threshold (max(interval, floor/2))
+    assert len(b._payloads) < peak // 2 + 64, (len(b._payloads), peak)

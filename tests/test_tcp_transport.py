@@ -247,3 +247,29 @@ def test_legacy_peer_never_receives_compressed_frames():
     finally:
         a.close()
         srv.close()
+
+
+def test_device_of_local_vs_remote():
+    """device_of: same-process names report their replica's pinned
+    device (device plane applies); remote addresses always report None
+    (cross-host slices must serialise — host plane)."""
+    import jax
+
+    from delta_crdt_ex_tpu import AWLWWMap
+    from delta_crdt_ex_tpu.api import start_link
+    from delta_crdt_ex_tpu.runtime import tcp_transport as T
+    from delta_crdt_ex_tpu.runtime.clock import LogicalClock
+
+    ta, tb = T.TcpTransport(), T.TcpTransport()
+    d0 = jax.devices()[0]
+    try:
+        a = start_link(AWLWWMap, threaded=False, transport=ta, name="a",
+                       clock=LogicalClock(), capacity=64, tree_depth=6, device=d0)
+        assert ta.device_of("a") is d0
+        assert ta.device_of(("a", ta.endpoint)) is d0  # self-remote resolves local
+        assert ta.device_of(("a", tb.endpoint)) is None  # genuinely remote
+        assert tb.device_of(("a", ta.endpoint)) is None
+        a.transport.unregister(a.name)
+    finally:
+        ta.close()
+        tb.close()
