@@ -908,25 +908,18 @@ class Replica:
         pay = self._payloads
         payloads = {dot: pay[dot] for dot in zip(gid_l, row_l, ctr_l)}
 
+        cols = {c: getattr(sl, c) for c in _SLICE_COLUMNS}
+        cols["ctx_rows"], cols["ctx_lo"], cols["ctx_gid"] = sl.ctx_rows, sl.ctx_lo, sl.ctx_gid
         if target_device is None:
             # reuse the host copies the payload build already made —
             # node/ctr/alive must not pay a second device→host transfer
-            host = {"node": node_h, "ctr": ctr_h, "alive": alive_h}
-            arrays = {
-                c: host.get(c) if c in host else np.asarray(getattr(sl, c))
-                for c in _SLICE_COLUMNS
-            }
-            arrays["ctx_rows"] = np.asarray(sl.ctx_rows)
-            arrays["ctx_lo"] = np.asarray(sl.ctx_lo)
-            arrays["ctx_gid"] = gid_h
+            host = {"node": node_h, "ctr": ctr_h, "alive": alive_h, "ctx_gid": gid_h}
+            arrays = {c: host[c] if c in host else np.asarray(v) for c, v in cols.items()}
         else:
             import jax
 
-            put = lambda x: jax.device_put(x, target_device)  # noqa: E731
-            arrays = {c: put(getattr(sl, c)) for c in _SLICE_COLUMNS}
-            arrays["ctx_rows"] = put(sl.ctx_rows)
-            arrays["ctx_lo"] = put(sl.ctx_lo)
-            arrays["ctx_gid"] = put(sl.ctx_gid)
+            # one pytree put: a single placement call for all columns
+            arrays = jax.device_put(cols, target_device)
         arrays["rows"] = rows  # row indices are control metadata: numpy
         return arrays, payloads
 
@@ -935,9 +928,12 @@ class Replica:
         registered replicas' pinned devices), or None if any is unpinned
         or they differ — a fanned-out message body is built once, so the
         device plane applies only when one placement serves the group."""
+        device_of = getattr(self.transport, "device_of", None)
+        if device_of is None:
+            return None
         dev = None
         for n in peers:
-            d = getattr(self.transport, "device_of", lambda _n: None)(n)
+            d = device_of(n)
             if d is None or (dev is not None and d != dev):
                 return None
             dev = d
