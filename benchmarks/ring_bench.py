@@ -1,0 +1,75 @@
+"""8-replica ring convergence latency — the BASELINE.json config
+"bench/propagation.exs — 8-replica ring, 10k keys, convergence latency".
+
+Eight threaded runtime replicas wired in a ONE-WAY ring (directional
+edges, like the reference's ``set_neighbours``); replica 0 writes N
+keys; the clock stops when every replica reads the full map. Data
+reaches the far side of the ring transitively: eager pushes cover each
+hop's own dots, the digest walk relays the rest — 7 hops of real
+anti-entropy machinery, timers and all.
+
+Run: ``python -m benchmarks.ring_bench [N ...]``  (default 10000)
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from delta_crdt_ex_tpu import AWLWWMap
+from delta_crdt_ex_tpu.api import start_link
+from delta_crdt_ex_tpu.runtime.transport import LocalTransport
+from benchmarks.common import emit, log
+
+RING = 8
+
+
+def run(number: int) -> dict:
+    transport = LocalTransport()
+    reps = [
+        start_link(
+            AWLWWMap,
+            transport=transport,
+            sync_interval=0.02,
+            capacity=max(4096, 4 * number),
+            tree_depth=12,
+            max_sync_size=500,
+            name=f"ring-{i}",
+        )
+        for i in range(RING)
+    ]
+    for i, r in enumerate(reps):
+        r.set_neighbours([reps[(i + 1) % RING]])  # one-way ring
+
+    t_write0 = time.perf_counter()
+    for x in range(number):
+        reps[0].mutate_async("add", [x, x])
+    reps[0].flush()
+    write_s = time.perf_counter() - t_write0
+
+    t0 = time.perf_counter()
+    deadline = t0 + 600
+    while time.perf_counter() < deadline:
+        if all(len(r.read()) == number for r in reps):
+            break
+        time.sleep(0.05)
+    conv_s = time.perf_counter() - t0
+    ok = all(r.read() == {x: x for x in range(number)} for r in reps)
+    for r in reps:
+        r.stop()
+    assert ok, "ring did not converge to the full map"
+    log(f"ring({RING}) {number} keys: write {write_s:.2f}s, converge {conv_s:.2f}s")
+    return {f"write_s@{number}": round(write_s, 2), f"converge_s@{number}": round(conv_s, 2)}
+
+
+def main(sizes=(10_000,)):
+    results = {}
+    for n in sizes:
+        results.update(run(n))
+    emit("ring_bench", results)
+    return results
+
+
+if __name__ == "__main__":
+    sizes = tuple(int(a) for a in sys.argv[1:]) or (10_000,)
+    main(sizes)

@@ -52,6 +52,8 @@ PROP_DEVICE_PLANE=1 timeout 1800 python -m benchmarks.propagation >>"$LOG" 2>&1 
   && say "propagation (device plane) done" || say "propagation (device plane) FAILED"
 timeout 2400 python -m benchmarks.full_bench >>"$LOG" 2>&1 \
   && say "full_bench done" || say "full_bench FAILED"
+timeout 1800 python -m benchmarks.ring_bench >>"$LOG" 2>&1 \
+  && say "ring_bench done" || say "ring_bench FAILED"
 timeout 1200 python -m benchmarks.mesh_gossip >>"$LOG" 2>&1 \
   && say "mesh_gossip done" || say "mesh_gossip FAILED"
 
