@@ -1182,6 +1182,22 @@ class Replica:
         self._thread.start()
         return self
 
+    def crash(self) -> None:
+        """Fault injection: die WITHOUT the terminate-path goodbye sync.
+
+        The node-loss simulation (the reference's tests kill the owning
+        process, ``causal_crdt_test.exs:87-102``): the event loop stops
+        mid-flight, nothing is flushed or synced beyond what
+        ``storage_mode`` already persisted, and deregistration fires
+        ``Down`` at monitoring peers. A later ``start_link`` with the
+        same name + storage rehydrates with node-id continuity."""
+        if self._thread is not None:
+            self._stop.set()
+            self._wake.set()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.transport.unregister(self.name)
+
     def stop(self) -> None:
         """Terminate: best-effort final sync (reference ``terminate/2``,
         ``causal_crdt.ex:200-204``), then deregister (fires Down at

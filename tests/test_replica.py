@@ -539,3 +539,21 @@ def test_mass_remove_wave_prunes_receiver_dicts(transport, shared_clock):
     # kills pressured gc on the receiver: dict well below peak, bounded
     # by live + the pre-gc threshold (max(interval, floor/2))
     assert len(b._payloads) < peak // 2 + 64, (len(b._payloads), peak)
+
+
+def test_crash_skips_goodbye_sync(transport, shared_clock):
+    """crash() must NOT flush or sync pending work (stop() does both):
+    peers keep only what already propagated, and monitors get Down."""
+    a = mk(transport, shared_clock)
+    b = mk(transport, shared_clock)
+    a.set_neighbours([b])
+    b.set_neighbours([a])
+    a.mutate("add", ["seen", 1])
+    converge(transport, [a, b])
+    assert b.read() == {"seen": 1}
+
+    a.mutate_async("add", ["unflushed", 2])  # queued, never flushed
+    a.crash()
+    transport.pump()
+    assert b.read() == {"seen": 1}, "crash leaked a goodbye sync"
+    assert not transport.alive(a.addr)
