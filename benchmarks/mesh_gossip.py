@@ -17,13 +17,17 @@ import time
 
 import numpy as np
 
-if os.environ.get("JAX_PLATFORMS", "") in ("", "cpu"):
+if (
+    os.environ.get("JAX_PLATFORMS", "") in ("", "cpu")
+    and "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
+):
     # a bare-CPU invocation would otherwise measure a 1-device "ring"
     # (trivial steps, heal in 2) and quietly record nonsense. Must run
     # before importing benchmarks.common, whose compilation-cache setup
     # initialises the backend (the host device count parses only once).
-    # Never force when an accelerator platform is pinned — the TPU
-    # matrix must measure the chip mesh or fail the n>1 assert loudly.
+    # An explicit XLA_FLAGS device count is honoured; never force when an
+    # accelerator platform is pinned — the TPU matrix must measure the
+    # chip mesh or fail the n>1 assert loudly.
     from delta_crdt_ex_tpu.utils.devices import force_cpu_devices
 
     force_cpu_devices(8)
@@ -52,7 +56,9 @@ def main():
     mesh = make_mesh()
     log(f"mesh: {n} devices ({jax.default_backend()})")
 
-    L, B, R = 1 << 10, 32, 8
+    # writer-table slots must cover every mesh writer (post-gossip each
+    # state knows all n gids; this bench asserts tier flags, no auto-grow)
+    L, B, R = 1 << 10, 32, max(8, n)
     states = []
     for i in range(n):
         st = BinnedStore.new(L, B, R)
