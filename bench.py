@@ -437,7 +437,12 @@ def _metric_name(fallback: bool) -> str:
 def main():
     if "--tpu-child" in sys.argv:
         mps, sec_failed = bench_tpu()
-        out = {"merges_per_sec": mps}
+        import jax
+
+        # the child names the backend it ACTUALLY ran on, so the parent
+        # can never emit an accelerator-named metric for a CPU run
+        # (e.g. someone invoking the bench under JAX_PLATFORMS=cpu)
+        out = {"merges_per_sec": mps, "backend": jax.default_backend()}
         if sec_failed:
             out["secondary_assert_failed"] = True
         print(json.dumps(out), flush=True)
@@ -536,6 +541,17 @@ def _main_measured(budget: Budget, fallback_reserve: float, run_state: dict):
         )
         if res is None:
             log("ACCELERATOR RUN FAILED — see stage logs above")
+        elif res.get("backend") == "cpu":
+            # explicitly-CPU environment: the number is honest but must
+            # carry the CPU label — never the accelerator metric name
+            log("child ran on the CPU backend — labelling _cpu_fallback")
+            run_state["fallback"] = True
+            if os.environ.get("BENCH_NO_CPU_FALLBACK") == "1":
+                # the no-fallback contract means a CPU number is useless
+                # however it came about — fail fast here too
+                raise SystemExit(
+                    "child ran on CPU and BENCH_NO_CPU_FALLBACK=1"
+                )
     if res is None and os.environ.get("BENCH_NO_CPU_FALLBACK") == "1":
         # interactive TPU sessions: a CPU number is useless, fail fast
         # (main() still guarantees an error-labelled artifact line)

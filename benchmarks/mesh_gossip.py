@@ -17,20 +17,22 @@ import time
 
 import numpy as np
 
-if (
-    os.environ.get("JAX_PLATFORMS", "") in ("", "cpu")
-    and "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
-):
+if os.environ.get("JAX_PLATFORMS", "") in ("", "cpu"):
     # a bare-CPU invocation would otherwise measure a 1-device "ring"
     # (trivial steps, heal in 2) and quietly record nonsense. Must run
     # before importing benchmarks.common, whose compilation-cache setup
-    # initialises the backend (the host device count parses only once).
-    # An explicit XLA_FLAGS device count is honoured; never force when an
-    # accelerator platform is pinned — the TPU matrix must measure the
-    # chip mesh or fail the n>1 assert loudly.
-    from delta_crdt_ex_tpu.utils.devices import force_cpu_devices
+    # initialises the backend (the host device count parses only once),
+    # and must go through force_cpu_devices even when the count is
+    # explicit — the env var alone doesn't pin the platform on images
+    # whose boot hook pre-imports jax. An explicit XLA_FLAGS count is
+    # honoured; never force when an accelerator platform is pinned — the
+    # TPU matrix must measure the chip mesh or fail the n>1 assert loudly.
+    import re
 
-    force_cpu_devices(8)
+    from delta_crdt_ex_tpu.utils.devices import _FLAG, force_cpu_devices
+
+    _m = re.search(rf"--{_FLAG}=(\d+)", os.environ.get("XLA_FLAGS", ""))
+    force_cpu_devices(int(_m.group(1)) if _m else 8)
 
 from benchmarks.common import emit, log
 
