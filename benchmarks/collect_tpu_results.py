@@ -1,0 +1,92 @@
+"""Collect one chip window's evidence into a BASELINE.md-ready digest.
+
+Reads /tmp/northstar.json, benchmarks/results/*.tpu.json, and the
+matrix log, then prints (a) a markdown fragment for BASELINE.md's TPU
+column and (b) the north-star verdict vs the >=10x target — so a short
+chip window spends its minutes measuring, not collating.
+
+Run after ``run_tpu_matrix.sh``: ``python -m benchmarks.collect_tpu_results``
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(path):
+    """Whole-file JSON (results files may be indented), else the last
+    line (the north-star file is captured stdout: stderr noise above,
+    artifact line last)."""
+    try:
+        with open(path) as f:
+            text = f.read().strip()
+    except OSError:
+        return None
+    for candidate in (text, text.splitlines()[-1] if text else ""):
+        try:
+            return json.loads(candidate)
+        except ValueError:
+            continue
+    return None
+
+
+def main():
+    log = sys.argv[1] if len(sys.argv) > 1 else "/tmp/tpu_matrix.log"
+    out = []
+    ns = _load("/tmp/northstar.json")
+    chip_success = False
+    if ns is None:
+        out.append("north-star: NO ARTIFACT at /tmp/northstar.json")
+    elif "error" in ns:
+        # bench.py's failure artifacts (claim failure, interrupt, crash)
+        # carry an "error" field and exit 0 by contract — never present
+        # them as measurements
+        out.append(f"north-star: RUN FAILED — {ns.get('metric')}: {ns.get('error')}")
+    else:
+        ratio = ns.get("vs_baseline", 0)
+        fallback = "cpu_fallback" in ns.get("metric", "")
+        tag = "  (CPU FALLBACK — not a chip number)" if fallback else ""
+        verdict = "MEETS" if ratio >= 10 else "below"
+        out.append(f"north-star: {ns.get('value')} merges/sec, vs_baseline {ratio} — {verdict} the >=10x target{tag}")
+        if ns.get("secondary_assert_failed"):
+            out.append("  WARNING: GROUP=1 secondary tripped its overflow assertion")
+        chip_success = not fallback
+
+    rows = []
+    for path in sorted(glob.glob(os.path.join(REPO, "benchmarks", "results", "*.tpu.json"))):
+        data = _load(path)
+        if not data:
+            continue
+        bench = data.get("bench", os.path.basename(path))
+        cells = {
+            k: v for k, v in data.items()
+            if k not in ("bench", "backend", "devices", "utc")
+        }
+        rows.append(f"| {bench} ({data.get('utc', '?')}) | " +
+                    ", ".join(f"{k}={v}" for k, v in cells.items()) + " |")
+    if rows:
+        out.append("\nTPU harness rows (paste into BASELINE.md):")
+        out.extend(rows)
+    else:
+        out.append("no *.tpu.json results found — did the matrix run on the chip?")
+
+    if os.path.exists(log):
+        with open(log, errors="replace") as f:
+            lines = [l for l in f if "digest tree:" in l or "group=1 secondary" in l]
+        if lines:
+            out.append(f"\nkernel evidence from {log}:")
+            out.extend("  " + l.strip() for l in lines[-6:])
+    else:
+        out.append(f"\n(no matrix log at {log} — pass the logfile used by run_tpu_matrix.sh)")
+
+    print("\n".join(out))
+    return 0 if chip_success else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
