@@ -27,12 +27,10 @@ if os.environ.get("JAX_PLATFORMS", "") in ("", "cpu"):
     # whose boot hook pre-imports jax. An explicit XLA_FLAGS count is
     # honoured; never force when an accelerator platform is pinned — the
     # TPU matrix must measure the chip mesh or fail the n>1 assert loudly.
-    import re
+    from delta_crdt_ex_tpu.utils.devices import forced_device_count, force_cpu_devices
 
-    from delta_crdt_ex_tpu.utils.devices import _FLAG, force_cpu_devices
-
-    _m = re.search(rf"--{_FLAG}=(\d+)", os.environ.get("XLA_FLAGS", ""))
-    force_cpu_devices(int(_m.group(1)) if _m else 8)
+    _n = forced_device_count()
+    force_cpu_devices(_n if _n is not None else 8)
 
 from benchmarks.common import emit, log
 

@@ -34,6 +34,7 @@ import threading
 import time
 from typing import Any, Callable
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -208,8 +209,6 @@ class Replica:
             self.state = state
             self.self_slot = 0
         if device is not None:
-            import jax
-
             # commit the state to the device: every jitted kernel over it
             # then runs (and allocates its outputs) there
             self.state = jax.device_put(self.state, device)
@@ -896,11 +895,12 @@ class Replica:
         """
         # host gathers for the payload dict (needed on either plane) —
         # one numpy pass + a batched tolist beats per-entry scalar
-        # indexing ~10x on big slices (VERDICT r2 weak #4)
-        node_h = np.asarray(sl.node)
-        ctr_h = np.asarray(sl.ctr)
-        alive_h = np.asarray(sl.alive)
-        gid_h = np.asarray(sl.ctx_gid)
+        # indexing ~10x on big slices (VERDICT r2 weak #4). device_get on
+        # the tuple starts all four copies before blocking: one device
+        # sync per slice instead of four sequential np.asarray syncs
+        node_h, ctr_h, alive_h, gid_h = jax.device_get(
+            (sl.node, sl.ctr, sl.alive, sl.ctx_gid)
+        )
         u_idx, b_idx = np.nonzero(alive_h)
         gid_l = gid_h[node_h[u_idx, b_idx]].tolist()
         row_l = rows[u_idx].tolist()
@@ -916,8 +916,6 @@ class Replica:
             host = {"node": node_h, "ctr": ctr_h, "alive": alive_h, "ctx_gid": gid_h}
             arrays = {c: host[c] if c in host else np.asarray(v) for c, v in cols.items()}
         else:
-            import jax
-
             # one pytree put: a single placement call for all columns
             arrays = jax.device_put(cols, target_device)
         arrays["rows"] = rows  # row indices are control metadata: numpy
@@ -1038,10 +1036,11 @@ class Replica:
             {
                 "duration_s": time.perf_counter() - t0,
                 "buckets": int(len(msg.buckets)),
-                # .sum() runs wherever the column lives: numpy on host
-                # (host plane), device reduction + scalar readback
-                # (device plane) — no cross-plane transfer either way
-                "entries": int(a["alive"].sum()),
+                # one payload per alive dot in the slice (_slice_wire
+                # builds the dict from np.nonzero(alive)), so this counts
+                # shipped entries from host data — the device-plane alive
+                # column is never reduced/read back just for telemetry
+                "entries": len(msg.payloads),
             },
             {
                 "name": self.name,
@@ -1078,8 +1077,6 @@ class Replica:
 
     def hibernate(self) -> str:
         """Quiesce before timing: flush, prune host dicts, drain device."""
-        import jax
-
         with self._lock:
             self._flush()
             self.gc()

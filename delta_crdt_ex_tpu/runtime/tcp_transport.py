@@ -34,6 +34,7 @@ import queue
 import socket
 import struct
 import threading
+import time
 from typing import Any, Hashable
 
 from delta_crdt_ex_tpu.runtime.transport import Down
@@ -55,6 +56,11 @@ _WIRE_VERSION = 1
 _FEAT_MSGZ = 1  # feature bit: peer accepts zlib-compressed _MSG frames
 _OUR_FEATURES = _FEAT_MSGZ
 
+#: how long the HELLO waiter keeps reading for a late reply before giving
+#: up (several socket timeouts — a loaded peer may accept late; a legacy
+#: peer never replies and just costs one daemon thread for this window)
+_HELLO_WAIT_S = 30.0
+
 #: compress frames at least this large. Sync payloads are padded
 #: static-shape arrays (mostly zeros), so cheap level-1 zlib typically
 #: shrinks them 10-50x — real bandwidth on the DCN leg; tiny control
@@ -68,8 +74,9 @@ def _send_frame(sock: socket.socket, kind: int, payload: bytes) -> None:
 
 def _recv_frame(sock: socket.socket) -> tuple[int, bytes] | None:
     """Read one length-prefixed frame; ``(kind, payload)`` or None on a
-    short read. The single wire-format parse — every reader (serve loop,
-    ping round-trip, HELLO waiter) goes through here."""
+    short read. The serve loop and ping round-trip parse through here;
+    the HELLO waiter keeps its own cross-timeout byte buffer (it must not
+    drop partial reads), so a wire-format change must update both."""
     hdr = _recv_exact(sock, 4)
     if hdr is None:
         return None
@@ -97,12 +104,39 @@ def _start_hello_negotiation(conn: "_SenderConn") -> None:
         return  # the sender thread will discover the dead socket itself
 
     def wait_reply() -> None:
-        try:
-            frame = _recv_frame(conn.sock)
-            if frame is not None and frame[0] == _HELLO and len(frame[1]) >= 2:
-                conn.accepts_z = bool(frame[1][1] & _FEAT_MSGZ)
-        except OSError:
-            pass  # timeout/reset: stay feature-less
+        # Keep reading until the HELLO lands or a deadline passes: one
+        # socket-timeout's grace is not enough for a loaded peer whose
+        # accept loop replies late, and leaving the reply unread would
+        # pin compression off for the connection's whole lifetime.
+        # Bytes are accumulated locally (not via _recv_exact, which drops
+        # its partial buffer when a timeout fires mid-frame), so a reply
+        # that trickles in across several read timeouts still parses at
+        # the right frame boundary. Only timeouts keep the loop going —
+        # any other socket error means the connection is gone.
+        deadline = time.monotonic() + _HELLO_WAIT_S
+        buf = b""
+        while time.monotonic() < deadline:
+            try:
+                chunk = conn.sock.recv(65536)
+            except TimeoutError:
+                continue  # per-read timeout paces the wait; buf is kept
+            except OSError:
+                return  # reset/closed: stay feature-less
+            if not chunk:
+                return  # peer closed
+            buf += chunk
+            while len(buf) >= 4:
+                ln = _LEN.unpack(buf[:4])[0]
+                if len(buf) < 4 + ln:
+                    break
+                body, buf = buf[4 : 4 + ln], buf[4 + ln :]
+                # body = [kind, payload...]; HELLO payload = [ver, features]
+                if ln >= 1 and body[0] == _HELLO:
+                    if ln >= 3:
+                        conn.accepts_z = bool(body[2] & _FEAT_MSGZ)
+                    return  # a short/malformed HELLO concludes feature-less
+                # other frame kinds on an outbound conn are unexpected —
+                # skip and keep waiting for the HELLO
 
     threading.Thread(target=wait_reply, daemon=True,
                      name="tcp-hello-wait").start()

@@ -18,6 +18,14 @@ import re
 _FLAG = "xla_force_host_platform_device_count"
 
 
+def forced_device_count() -> int | None:
+    """The virtual host-device count requested via ``XLA_FLAGS``, or
+    None when the flag is absent — the public read-side of the flag this
+    module owns (callers must not parse ``XLA_FLAGS`` themselves)."""
+    m = re.search(rf"--{_FLAG}=(\d+)", os.environ.get("XLA_FLAGS", ""))
+    return int(m.group(1)) if m else None
+
+
 def backend_initialised(default: bool = True) -> bool:
     """Whether any XLA backend has been created in this process.
 
