@@ -83,8 +83,16 @@ def main():
         m = crdt.read()
         dt = time.perf_counter() - t0
         assert len(m) == 1_000_000 and m[123456] == 123456
-        results["read_1m_s"] = round(dt, 2)
-        log(f"full read of 1M-key map: {dt:.2f}s")
+        results["read_1m_s"] = round(dt, 3)
+        log(f"full read of 1M-key map (maintained cache): {dt:.3f}s")
+        # the post-merge path: cache invalidated, full winner pass rebuild
+        crdt._read_cache = None
+        t0 = time.perf_counter()
+        m = crdt.read()
+        dt = time.perf_counter() - t0
+        assert len(m) == 1_000_000
+        results["read_1m_cold_rebuild_s"] = round(dt, 2)
+        log(f"full read of 1M-key map (cold winner-pass rebuild): {dt:.2f}s")
         crdt.read_keys(list(range(100, 1100)))  # warm the partial-read compile
         t0 = time.perf_counter()
         part = crdt.read_keys(list(range(5000, 6000)))

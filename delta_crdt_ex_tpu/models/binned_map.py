@@ -33,6 +33,7 @@ jit_extract_rows = jax.jit(binned_ops.extract_rows)
 jit_extract_own_delta = jax.jit(binned_ops.extract_own_delta)
 jit_winners_for_keys = jax.jit(binned_ops.winners_for_keys)
 jit_winner_rows = jax.jit(binned_ops.winner_rows)
+jit_winner_all = jax.jit(binned_ops.winner_all)
 jit_compact_rows = jax.jit(binned_ops.compact_rows)
 jit_tree_from_leaves = jax.jit(binned_ops.tree_from_leaves)
 
@@ -239,6 +240,7 @@ class BinnedAWLWWMap:
     extract_own_delta = staticmethod(jit_extract_own_delta)
     winners_for_keys = staticmethod(jit_winners_for_keys)
     winner_rows = staticmethod(jit_winner_rows)
+    winner_all = staticmethod(jit_winner_all)
     compact_rows = staticmethod(jit_compact_rows)
     tree_from_leaves = staticmethod(jit_tree_from_leaves)
     merge_into = staticmethod(merge_into)

@@ -931,29 +931,11 @@ class RowWinners(NamedTuple):
     ts: jnp.ndarray  # int64[U, B]
 
 
-def winner_rows(state: BinnedStore, rows: jnp.ndarray) -> RowWinners:
-    """Per-key LWW winners within the given bucket rows (full-map read =
-    all rows, chunked by the host). An entry wins iff no other alive
-    same-key entry in its row ranks higher (keys never span rows).
-
-    Implementation: one lexicographic multi-operand sort per row by
-    (key, ts, gid, ctr) — O(B log B) lanes instead of the O(B²) pairwise
-    compare — then a winner is the **last entry of its key-run** (dead
-    entries rank below everything, so a run whose last entry is dead is
-    entirely dead). Returned arrays are in row-sorted order; callers
-    select by ``win``, never by position."""
-    L = state.num_buckets
-    valid = rows >= 0
-    rows_clip = jnp.clip(rows, 0, L - 1)
-    key = state.key[rows_clip]
-    ts = state.ts[rows_clip]
-    ctr = state.ctr[rows_clip]
-    gid = _table_lookup(
-        state.ctx_gid, jnp.clip(state.node[rows_clip], 0, state.replica_capacity - 1)
-    )
-    valh = state.valh[rows_clip]
-    alive = state.alive[rows_clip] & valid[:, None]
-
+def _sorted_winners(key, ts, gid, ctr, alive, valh) -> "RowWinners":
+    """Shared winner core: one lexicographic multi-operand sort per row
+    by (key, ts, gid, ctr); a winner is the last entry of its key-run
+    (dead entries rank below everything, so a run whose last entry is
+    dead is entirely dead). Returned arrays are in row-sorted order."""
     t, g, c = _lww_rank(ts, gid, ctr, alive)
     key_s, t_s, g_s, c_s, alive_s, valh_s = jax.lax.sort(
         (key, t, g, c, alive, valh), dimension=1, num_keys=4
@@ -961,8 +943,45 @@ def winner_rows(state: BinnedStore, rows: jnp.ndarray) -> RowWinners:
     run_last = jnp.concatenate(
         [key_s[:, :-1] != key_s[:, 1:], jnp.ones((key_s.shape[0], 1), bool)], axis=1
     )
-    win = alive_s & run_last
-    return RowWinners(win, key_s, g_s, c_s, valh_s, t_s)
+    return RowWinners(alive_s & run_last, key_s, g_s, c_s, valh_s, t_s)
+
+
+def winner_all(state: BinnedStore) -> RowWinners:
+    """Whole-table LWW winners (:func:`winner_rows` with every row, minus
+    the row gather): the full-map read path (``AWLWWMap.read/1``,
+    ``aw_lww_map.ex:211-216``) sorts the entry table once instead of
+    gathering bucket chunks through an index — one device call for the
+    1M-key read."""
+    gid = _table_lookup(
+        state.ctx_gid, jnp.clip(state.node, 0, state.replica_capacity - 1)
+    )
+    return _sorted_winners(
+        state.key, state.ts, gid, state.ctr, state.alive, state.valh
+    )
+
+
+def winner_rows(state: BinnedStore, rows: jnp.ndarray) -> RowWinners:
+    """Per-key LWW winners within the given bucket rows (full-map read =
+    all rows, chunked by the host). An entry wins iff no other alive
+    same-key entry in its row ranks higher (keys never span rows).
+
+    Implementation: :func:`_sorted_winners` over the gathered rows —
+    O(B log B) lanes instead of the O(B²) pairwise compare. Callers
+    select by ``win``, never by position."""
+    L = state.num_buckets
+    valid = rows >= 0
+    rows_clip = jnp.clip(rows, 0, L - 1)
+    gid = _table_lookup(
+        state.ctx_gid, jnp.clip(state.node[rows_clip], 0, state.replica_capacity - 1)
+    )
+    return _sorted_winners(
+        state.key[rows_clip],
+        state.ts[rows_clip],
+        gid,
+        state.ctr[rows_clip],
+        state.alive[rows_clip] & valid[:, None],
+        state.valh[rows_clip],
+    )
 
 
 # ---------------------------------------------------------------------------
