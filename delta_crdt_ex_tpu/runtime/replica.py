@@ -733,32 +733,36 @@ class Replica:
         # payload records are (key_term, value) pairs, so the winning
         # dots' records feed dict() directly — one C-level pass (bulk
         # __getitem__ via map) instead of a Python loop with a second
-        # per-key _key_terms lookup (VERDICT r3 weak #5: 1M-key read).
-        # Winners are inserted in ascending-ts order so that when the
-        # dict collapses ==-equal terms with distinct canonical keys
-        # (1 vs True) the LATEST write's value deterministically wins —
-        # the same rule the incremental replay applies.
+        # per-key _key_terms lookup (VERDICT r3 weak #5: 1M-key read)
         key, gid, ctr, _valh, ts = self._winner_arrays_rows(None)
-        order = np.argsort(ts, kind="stable")
-        key, gid, ctr = key[order], gid[order], ctr[order]
-        bucket = (key & np.uint64(self.num_buckets - 1)).astype(np.int64)
-        dots = zip(gid.tolist(), bucket.tolist(), ctr.tolist())
-        try:
-            out = dict(map(self._payloads.__getitem__, dots))
-        except TypeError:
-            for term, _value in self._payloads.values():
-                try:
-                    hash(term)
-                except TypeError:
-                    raise TypeError(
-                        f"key term {term!r} is unhashable in Python; use "
-                        "read_items() for maps with unhashable keys"
-                    ) from None
-            raise
-        # fewer slots than winners ⇒ ==-equal distinct-hash terms exist:
-        # the dict view is lossy, incremental maintenance is unsound
-        kh_map = dict(zip(out.keys(), key.tolist())) if len(out) == len(key) else None
-        return out, kh_map
+
+        def build(k, g, c):
+            bucket = (k & np.uint64(self.num_buckets - 1)).astype(np.int64)
+            dots = zip(g.tolist(), bucket.tolist(), c.tolist())
+            try:
+                return dict(map(self._payloads.__getitem__, dots))
+            except TypeError:
+                for term, _value in self._payloads.values():
+                    try:
+                        hash(term)
+                    except TypeError:
+                        raise TypeError(
+                            f"key term {term!r} is unhashable in Python; use "
+                            "read_items() for maps with unhashable keys"
+                        ) from None
+                raise
+
+        out = build(key, gid, ctr)
+        if len(out) == len(key):
+            # no ==-collapsed terms: incremental maintenance is sound,
+            # and insertion order was irrelevant (all dict keys distinct)
+            return out, dict(zip(out.keys(), key.tolist()))
+        # ==-equal terms with distinct canonical keys exist (1 vs True):
+        # the dict view is lossy. Rebuild inserting in ascending LWW
+        # order (ts, gid, ctr) so the collapse deterministically keeps
+        # the LWW-greatest write's value on every replica.
+        order = np.lexsort((ctr, gid, ts))
+        return build(key[order], gid[order], ctr[order]), None
 
     def _read_all_items(self) -> list[tuple[Any, Any]]:
         key, gid, ctr, _valh, _ts = self._winner_arrays_rows(None)
@@ -855,8 +859,8 @@ class Replica:
                 jnp.uint64(self.node_id),
                 jnp.asarray(lo),
             )
-            arrays, payloads = self._slice_wire(
-                sl, rows, self._common_device([n for n, _cur in members])
+            bodies, payloads = self._slice_bodies(
+                sl, rows, [n for n, _cur in members]
             )
             for n, cur in members:
                 msg = sync_proto.EntriesMsg(
@@ -864,7 +868,7 @@ class Replica:
                     frm=self.addr,
                     to=n,
                     buckets=pending.astype(np.int64),
-                    arrays=arrays,
+                    arrays=bodies[n],
                     payloads=payloads,
                 )
                 if self.transport.send(n, msg):
@@ -890,14 +894,14 @@ class Replica:
             rows = np.full(_wire(max(len(pend), 1)), -1, np.int32)
             rows[: len(pend)] = pend
             sl = self.model.extract_rows(self.state, jnp.asarray(rows))
-            arrays, payloads = self._slice_wire(sl, rows, self._common_device(members))
+            bodies, payloads = self._slice_bodies(sl, rows, members)
             for n in members:
                 msg = sync_proto.EntriesMsg(
                     originator=self.addr,
                     frm=self.addr,
                     to=n,
                     buckets=pend.astype(np.int64),
-                    arrays=arrays,
+                    arrays=bodies[n],
                     payloads=payloads,
                 )
                 if self.transport.send(n, msg):
@@ -974,29 +978,13 @@ class Replica:
         self._send_entries(to=msg.frm, buckets=msg.buckets, originator=msg.originator)
         self._outstanding.pop(msg.frm, None)
 
-    def _slice_wire(self, sl, rows: np.ndarray, target_device=None) -> tuple[dict, dict]:
-        """Serialise a RowSlice to the EntriesMsg wire format: the slice
-        column arrays (context rows for exactly the shipped buckets —
-        bucket-atomic sync: coverage never outruns content) plus the
-        payload dict of every alive dot in the slice.
-
-        Two data planes (SURVEY §5.8 hybrid):
-
-        - ``target_device=None`` — host plane: columns become numpy
-          (pickleable for cross-host transports).
-        - ``target_device=<jax device>`` — device plane: columns are
-          placed directly on the receiver's device (``jax.device_put``
-          rides ICI between chips; a same-device put is free), never
-          round-tripping through host buffers. The payload dict is host
-          data either way (arbitrary Python terms live off-device), and
-          building it needs host views of node/ctr/alive — small columns;
-          the wide key/ts columns stay on device.
-        """
-        # host gathers for the payload dict (needed on either plane) —
-        # one numpy pass + a batched tolist beats per-entry scalar
-        # indexing ~10x on big slices (VERDICT r2 weak #4). device_get on
-        # the tuple starts all four copies before blocking: one device
-        # sync per slice instead of four sequential np.asarray syncs
+    def _slice_payload_host(self, sl, rows: np.ndarray):
+        """Host copies of the narrow slice columns plus the payload dict
+        of every alive dot in the slice (needed on every plane: arbitrary
+        Python terms live off-device). One numpy pass + a batched tolist
+        beats per-entry scalar indexing ~10x on big slices (VERDICT r2
+        weak #4); ``device_get`` on the tuple starts all four copies
+        before blocking — one device sync per slice."""
         node_h, ctr_h, alive_h, gid_h = jax.device_get(
             (sl.node, sl.ctr, sl.alive, sl.ctx_gid)
         )
@@ -1006,41 +994,64 @@ class Replica:
         ctr_l = ctr_h[u_idx, b_idx].tolist()
         pay = self._payloads
         payloads = {dot: pay[dot] for dot in zip(gid_l, row_l, ctr_l)}
+        host = {"node": node_h, "ctr": ctr_h, "alive": alive_h, "ctx_gid": gid_h}
+        return host, payloads
 
+    def _slice_arrays(self, sl, host: dict, target_device, rows: np.ndarray) -> dict:
+        """The EntriesMsg column dict for one data plane (SURVEY §5.8
+        hybrid):
+
+        - ``target_device=None`` — host plane: columns become numpy
+          (pickleable for cross-host transports), reusing the host
+          copies the payload build already made.
+        - ``target_device=<jax device>`` — device plane: one pytree
+          ``device_put`` places all columns directly on the receiver's
+          device (rides ICI between chips; a same-device put is free),
+          never round-tripping through host buffers.
+        """
         cols = {c: getattr(sl, c) for c in _SLICE_COLUMNS}
         cols["ctx_rows"], cols["ctx_lo"], cols["ctx_gid"] = sl.ctx_rows, sl.ctx_lo, sl.ctx_gid
         if target_device is None:
-            # reuse the host copies the payload build already made —
-            # node/ctr/alive must not pay a second device→host transfer
-            host = {"node": node_h, "ctr": ctr_h, "alive": alive_h, "ctx_gid": gid_h}
             arrays = {c: host[c] if c in host else np.asarray(v) for c, v in cols.items()}
         else:
-            # one pytree put: a single placement call for all columns
             arrays = jax.device_put(cols, target_device)
         arrays["rows"] = rows  # row indices are control metadata: numpy
-        return arrays, payloads
+        return arrays
 
-    def _common_device(self, peers) -> "Any | None":
-        """The single device shared by every peer in ``peers`` (their
-        registered replicas' pinned devices), or None if any is unpinned
-        or they differ — a fanned-out message body is built once, so the
-        device plane applies only when one placement serves the group."""
+    def _slice_wire(self, sl, rows: np.ndarray, target_device=None) -> tuple[dict, dict]:
+        """Single-plane wire form of a RowSlice: the column arrays
+        (context rows for exactly the shipped buckets — bucket-atomic
+        sync: coverage never outruns content) plus the payload dict."""
+        host, payloads = self._slice_payload_host(sl, rows)
+        return self._slice_arrays(sl, host, target_device, rows), payloads
+
+    def _slice_bodies(self, sl, rows: np.ndarray, peers) -> tuple[dict, dict]:
+        """Fan-out wire bodies: ONE arrays dict per distinct pinned
+        device among ``peers`` (None = host plane), shared payloads.
+        Mixed-placement clusters keep the device plane per group —
+        a 64-neighbour fan-out across 8 devices builds 8 bodies, not 64
+        and not a host fallback for everyone (VERDICT r3 weak #4).
+        Returns ``({peer: arrays}, payloads)``."""
+        host, payloads = self._slice_payload_host(sl, rows)
         device_of = getattr(self.transport, "device_of", None)
-        if device_of is None:
-            return None
-        dev = None
+        groups: dict[Any, list] = {}
         for n in peers:
-            d = device_of(n)
-            if d is None or (dev is not None and d != dev):
-                return None
-            dev = d
-        return dev
+            d = device_of(n) if device_of is not None else None
+            groups.setdefault(d, []).append(n)
+        by_peer: dict[Any, dict] = {}
+        for dev, members in groups.items():
+            arrays = self._slice_arrays(sl, host, dev, rows)
+            for n in members:
+                by_peer[n] = arrays
+        return by_peer, payloads
 
     def _send_entries(self, to, buckets: np.ndarray, originator) -> bool:
         rows = np.full(_wire(max(len(buckets), 1)), -1, np.int32)
         rows[: len(buckets)] = np.asarray(buckets, np.int32)
         sl = self.model.extract_rows(self.state, jnp.asarray(rows))
-        arrays, payloads = self._slice_wire(sl, rows, self._common_device([to]))
+        device_of = getattr(self.transport, "device_of", None)
+        dev = device_of(to) if device_of is not None else None
+        arrays, payloads = self._slice_wire(sl, rows, dev)
         return self.transport.send(
             to,
             sync_proto.EntriesMsg(

