@@ -172,6 +172,7 @@ def gossip_delta_drive(
     ts: jnp.ndarray,
     frontier: int = 64,
     on_grow=None,
+    gather=None,
 ):
     """Host recovery loop around :func:`gossip_delta_step`: a failed step
     (any ``ok=False``) discards that step's states, grows the offending
@@ -179,6 +180,13 @@ def gossip_delta_drive(
     idempotently because the failed result was never kept. Growth policy:
     gid table ×2, bin capacity ×2 after one compact; each retier
     recompiles the step for the new shapes.
+
+    ``gather`` (multi-controller meshes): a callable returning the FULL
+    ``oks``/``flags`` arrays as host values — e.g.
+    ``partial(multihost_utils.process_allgather, tiled=True)``. Without
+    it ``np.asarray`` on a process-spanning array would fail; with it
+    every controller sees identical values and takes the same
+    grow/replay decisions, keeping the SPMD programs in lockstep.
 
     Returns ``(stacked, roots, n_diff, n_retiers)``.
     """
@@ -190,10 +198,14 @@ def gossip_delta_drive(
             mesh, stacked, self_slot, rows, op, key, valh, ts,
             frontier=frontier,
         )
-        if bool(np.asarray(oks).all()):
+        oks_h = np.asarray(gather(oks) if gather else oks)
+        if bool(oks_h.all()):
             return out, roots, n_diff, retiers
+        # gather flags only on the (rare) failure path — identical on
+        # every controller, so the branch above stays in lockstep
+        flags_h = np.asarray(gather(flags) if gather else flags)
         retiers += 1
-        f = np.asarray(flags).any(axis=0)  # [3] any replica
+        f = flags_h.any(axis=0)  # [3] any replica
         apply_fill, gid_grow, merge_fill = map(bool, f)
         if gid_grow:
             stacked = stacked.grow(replica_capacity=stacked.replica_capacity * 2)

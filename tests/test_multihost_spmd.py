@@ -26,7 +26,7 @@ import delta_crdt_ex_tpu  # enables x64
 from delta_crdt_ex_tpu.models.binned import BinnedStore
 from delta_crdt_ex_tpu.ops.apply import OP_ADD
 from delta_crdt_ex_tpu.parallel.mesh_gossip import (
-    gossip_delta_step, make_mesh, replica_sharding,
+    gossip_delta_drive, make_mesh, replica_sharding,
 )
 
 n = len(jax.devices())
@@ -40,7 +40,7 @@ L = 64
 # contributes only its addressable shards
 states = []
 for i in range(n):
-    st = BinnedStore.new(L, 8, 8)
+    st = BinnedStore.new(L, 8, 4)  # writer table undersized on purpose
     st = dataclasses.replace(st, ctx_gid=st.ctx_gid.at[0].set(jnp.uint64(100 + i)))
     states.append(st)
 host = tu.tree_map(lambda *xs: np.stack([np.asarray(x) for x in xs]), *states)
@@ -51,7 +51,10 @@ def gput(x):
 stacked = tu.tree_map(gput, host)
 self_slot = gput(np.zeros(n, np.int32))
 
+from functools import partial
 from tests.test_parallel import grouped_mutations
+
+gather = partial(multihost_utils.process_allgather, tiled=True)
 
 def batches(ops_per_replica):
     # same wire shapes as the in-process mesh tests; re-place each array
@@ -60,19 +63,44 @@ def batches(ops_per_replica):
         gput(np.asarray(a)) for a in grouped_mutations(n, L, ops_per_replica)
     )
 
-seed = batches([[(OP_ADD, 1000 + i, i, i + 1)] for i in range(n)])
-stacked, roots, oks, n_diff, _fl = gossip_delta_step(mesh, stacked, self_slot, *seed)
-empty = batches([[] for _ in range(n)])
-for _ in range(2 * n):
-    stacked, roots, oks, n_diff, _fl = gossip_delta_step(mesh, stacked, self_slot, *empty)
+# a multi-op wave per replica; the writer table starts at 4 slots (< n
+# writers), so full gossip MUST grow it through gossip_delta_drive's
+# grow-and-replay path — across the process boundary
+grown = []
+seed = batches(
+    [[(OP_ADD, 1000 + 97 * i + j, i, 1 + i * 10 + j) for j in range(4)] for i in range(n)]
+)
+stacked, roots, n_diff, retiers = gossip_delta_drive(
+    mesh, stacked, self_slot, *seed,
+    gather=gather, on_grow=lambda st: grown.append(st.replica_capacity),
+)
 
-oks_g = multihost_utils.process_allgather(oks, tiled=True)
-roots_g = multihost_utils.process_allgather(roots, tiled=True)
-nd_g = multihost_utils.process_allgather(n_diff, tiled=True)
-assert bool(np.asarray(oks_g).all()), "a replica overflowed a tier"
+# heal with empty batches; the gathered per-step divergence must decay
+# to zero (ring propagation: each step moves entries one hop)
+empty = batches([[] for _ in range(n)])
+decay = [int(np.asarray(gather(n_diff)).max())]
+for _ in range(2 * n):
+    stacked, roots, n_diff, retiers_h = gossip_delta_drive(
+        mesh, stacked, self_slot, *empty,
+        gather=gather, on_grow=lambda st: grown.append(st.replica_capacity),
+    )
+    retiers += retiers_h
+    decay.append(int(np.asarray(gather(n_diff)).max()))
+    if decay[-1] == 0:
+        break
+
+assert decay[0] > 0, f"seed wave produced no divergence: {decay}"
+assert decay[-1] == 0, f"divergence left after ring heal: {decay}"
+assert max(grown, default=0) >= n, (
+    f"writer table never grew to mesh size across processes: {grown}"
+)
+roots_g = gather(roots)
 assert (np.asarray(roots_g) == np.asarray(roots_g).ravel()[0]).all(), "roots diverged"
-assert int(np.asarray(nd_g).max()) == 0, "divergence left"
-print(f"MULTIHOST_OK pid={pid} roots={np.asarray(roots_g).ravel()[0]}", flush=True)
+print(
+    f"MULTIHOST_OK pid={pid} roots={np.asarray(roots_g).ravel()[0]} "
+    f"decay={decay} grown={grown} retiers={retiers}",
+    flush=True,
+)
 """
 
 
