@@ -48,6 +48,11 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 SMOKE = os.environ.get("BENCH_SMOKE") == "1"
+#: A/B switch for the packed-entry layout (ops/packed.py — the
+#: roofline's single-vector-scatter lever). Parity-pinned to the column
+#: kernel; expected to LOSE on CPU, decided by chip numbers
+#: (BASELINE.md "Merge-kernel roofline").
+PACKED = os.environ.get("BENCH_PACKED") == "1"
 
 N_KEYS = 4096 if SMOKE else 1_000_000
 # geometry: load ≈ N_KEYS/L per bucket; bin capacity must clear the
@@ -140,9 +145,19 @@ def bench_tpu(seed=0):
     roots_of, tree_impl = _probed_roots_fn(1 << TREE_DEPTH)
     log(f"digest tree: {tree_impl}")
 
+    merge_fn = merge_slice
+    if PACKED:
+        from delta_crdt_ex_tpu.ops.packed import merge_slice_packed, pack
+
+        _stage("packing entry columns (BENCH_PACKED=1)…")
+        stacked = jax.jit(pack)(stacked)
+        jax.block_until_ready(stacked)
+        merge_fn = merge_slice_packed
+        log("merge layout: packed (one vector scatter per insert)")
+
     @partial_jit_donate
     def merge_chunk(states, sl):
-        res = jax.vmap(merge_slice, in_axes=(0, None, None, None))(
+        res = jax.vmap(merge_fn, in_axes=(0, None, None, None))(
             states, sl, 8, GROUP * DELTA
         )
         flags = jnp.stack(
@@ -189,7 +204,7 @@ def bench_tpu(seed=0):
 
         @partial_jit_donate
         def merge_one(states, s):
-            res = jax.vmap(merge_slice, in_axes=(0, None, None, None))(
+            res = jax.vmap(merge_fn, in_axes=(0, None, None, None))(
                 states, s, 8, DELTA
             )
             return res.state, res.ok
@@ -594,6 +609,7 @@ def _main_measured(budget: Budget, fallback_reserve: float, run_state: dict):
         "value": round(value, 2),
         "unit": "merges/sec",
         "vs_baseline": round(value / py, 3),
+        "layout": "packed" if PACKED else "columns",
     }
     if res.get("secondary_assert_failed"):
         # tier overflow in the GROUP=1 secondary is a correctness

@@ -57,6 +57,23 @@ def main():
             out.append("  WARNING: GROUP=1 secondary tripped its overflow assertion")
         chip_success = not fallback
 
+    pk = _load("/tmp/northstar_packed.json")
+    if pk is None:
+        out.append("packed A/B: no artifact (matrix predates it or run skipped)")
+    elif "error" in pk:
+        out.append(f"packed A/B: RUN FAILED — {pk.get('metric')}: {pk.get('error')}")
+    else:
+        base = ns.get("value") if ns and "error" not in ns else None
+        cmp = (
+            f" — {pk.get('value') / base:.2f}x vs columns"
+            if base
+            else ""
+        )
+        out.append(
+            f"packed A/B: {pk.get('value')} merges/sec (layout="
+            f"{pk.get('layout')}){cmp} — promote ops/packed.py if it wins"
+        )
+
     rows = []
     for path in sorted(glob.glob(os.path.join(REPO, "benchmarks", "results", "*.tpu.json"))):
         data = _load(path)

@@ -3,7 +3,7 @@
 # window opens, cheapest-first so a mid-session wedge still leaves
 # artifacts. The north-star numbers go to stdout and $LOG (bench.py
 # prints its JSON line to stdout only); the harness modules write
-# benchmarks/results/*.tpu.json. CPU fallbacks are disabled for the two
+# benchmarks/results/*.tpu.json. CPU fallbacks are disabled for all
 # bench.py runs (BENCH_NO_CPU_FALLBACK); the harness modules cannot fall
 # back silently either — the ambient JAX_PLATFORMS pin makes a dead
 # claim raise (step logs FAILED), and emit() stamps the backend into
@@ -42,6 +42,22 @@ if ok_line "$NORTH_LINE"; then
 else
   say "north-star FAILED: $NORTH_LINE (see $LOG)"
 fi
+
+say "packed-layout A/B (the roofline's vector-scatter lever; parity-pinned)"
+BENCH_PACKED=1 BENCH_TOTAL_BUDGET=2200 BENCH_CLAIM_TIMEOUT=120 \
+BENCH_CLAIM_ATTEMPTS=2 BENCH_TPU_TIMEOUT=2000 BENCH_NO_CPU_FALLBACK=1 \
+  timeout 2400 python bench.py > /tmp/northstar_packed.json 2>>"$LOG"
+PACKED_LINE=$(tail -1 /tmp/northstar_packed.json 2>/dev/null)
+if ok_line "$PACKED_LINE"; then
+  say "north-star (packed): $PACKED_LINE"
+  say "A/B columns-vs-packed: $NORTH_LINE | $PACKED_LINE"
+else
+  say "north-star (packed) FAILED: $PACKED_LINE (see $LOG)"
+fi
+
+say "merge-part probes (scatter/gather packing attribution)"
+timeout 1800 python -m benchmarks.profile_merge_parts >>"$LOG" 2>&1 \
+  && say "profile_merge_parts done" || say "profile_merge_parts FAILED"
 
 say "harness matrix on TPU (runtime-driven; dispatch-bound, numbers are honest)"
 timeout 1800 python -m benchmarks.basic_operations >>"$LOG" 2>&1 \

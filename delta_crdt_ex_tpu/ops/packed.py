@@ -1,0 +1,331 @@
+"""Packed-entry merge variant — the roofline's named lever (BASELINE.md
+"Merge-kernel roofline").
+
+The column-layout merge (:func:`delta_crdt_ex_tpu.ops.binned.merge_slice`)
+pays 7 element scatters per inserted entry — one per entry column — and
+TPU scatter cost is per index ENTRY, not per byte (measured ~10 ns/entry
+regardless of payload width). Packing the 7 entry columns into one
+``uint32[L, B, 8]`` word table turns them into ONE vector-valued scatter:
+a ~7× cut of the merge's dominant random-access term (the roofline's
+13.9k → ~50k merges/s ceiling move). The read side pays bitcast/unpack
+vector ops, which XLA fuses into consumers.
+
+This module is the pre-staged A/B candidate, NOT the default engine:
+
+- ``merge_slice_packed`` is bit-parity tested against ``merge_slice``
+  (``tests/test_packed_parity.py``) over randomized workloads;
+- the north-star bench runs it with ``BENCH_PACKED=1`` (``bench.py``),
+  and ``benchmarks/run_tpu_matrix.sh`` A/Bs both layouts in one chip
+  window;
+- CPU numbers are expected to LOSE (the probe measured plane
+  materialisation overwhelming the saved index entries there) — only a
+  chip measurement green-lights promotion to the default layout.
+
+Plane layout (all uint32): ``[key_lo, key_hi, ts_lo, ts_hi, valh, ctr,
+ehash, meta]`` with ``meta = node | alive << 16`` (writer slots are
+< 2^16 by construction — R tiers are small).
+
+Semantics are pinned to the column kernel, which itself pins to the
+reference join (``aw_lww_map.ex:153-209``); see ``merge_slice``'s
+docstring for the insert/kill/context contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from delta_crdt_ex_tpu.models.binned import BinnedStore, U32_MAX
+from delta_crdt_ex_tpu.ops.binned import (
+    MergeResult,
+    _row_amin,
+    _row_amax,
+    _slice_view,
+    _table_lookup,
+    encode_dot,
+    entry_hash,
+    flagged_first_order,
+)
+
+_PLANES = 8
+_META = 7
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["words", "fill", "amin", "amax", "leaf", "ctx_gid", "ctx_max"],
+    meta_fields=[],
+)
+@dataclasses.dataclass(frozen=True)
+class PackedStore:
+    """``BinnedStore`` with the 7 entry columns fused into one word
+    table. Aux/summary columns are identical; the duck-typed properties
+    let :func:`_slice_view` and the shared merge math run unchanged."""
+
+    words: jax.Array  # uint32[L, B, 8]
+    fill: jax.Array  # int32[L]
+    amin: jax.Array  # uint32[L, R]
+    amax: jax.Array  # uint32[L, R]
+    leaf: jax.Array  # uint32[L]
+    ctx_gid: jax.Array  # uint64[R]
+    ctx_max: jax.Array  # uint32[L, R]
+
+    @property
+    def num_buckets(self) -> int:
+        return self.words.shape[-3]
+
+    @property
+    def bin_capacity(self) -> int:
+        return self.words.shape[-2]
+
+    @property
+    def replica_capacity(self) -> int:
+        return self.ctx_gid.shape[-1]
+
+
+def _b32(a) -> jax.Array:
+    return jax.lax.bitcast_convert_type(a, jnp.uint32)
+
+
+def pack(state: BinnedStore) -> PackedStore:
+    """Column → packed layout (rank-agnostic: leading batch axes pass
+    through, so a neighbour-stacked state packs in one call)."""
+    assert state.replica_capacity < (1 << 16), "meta plane holds node in 16 bits"
+    meta = state.node.astype(jnp.uint32) | (
+        state.alive.astype(jnp.uint32) << jnp.uint32(16)
+    )
+    words = jnp.concatenate(
+        [
+            _b32(state.key),  # [..., L, B, 2]
+            _b32(state.ts),  # [..., L, B, 2]
+            state.valh[..., None],
+            state.ctr[..., None],
+            state.ehash[..., None],
+            meta[..., None],
+        ],
+        axis=-1,
+    )
+    return PackedStore(
+        words=words,
+        fill=state.fill,
+        amin=state.amin,
+        amax=state.amax,
+        leaf=state.leaf,
+        ctx_gid=state.ctx_gid,
+        ctx_max=state.ctx_max,
+    )
+
+
+def unpack(p: PackedStore) -> BinnedStore:
+    """Packed → column layout (bitwise inverse of :func:`pack`)."""
+    w = p.words
+    meta = w[..., _META]
+    return BinnedStore(
+        key=jax.lax.bitcast_convert_type(w[..., 0:2], jnp.uint64),
+        valh=w[..., 4],
+        ts=jax.lax.bitcast_convert_type(w[..., 2:4], jnp.int64),
+        node=(meta & jnp.uint32(0xFFFF)).astype(jnp.int32),
+        ctr=w[..., 5],
+        alive=(meta >> jnp.uint32(16)) != 0,
+        ehash=w[..., 6],
+        fill=p.fill,
+        amin=p.amin,
+        amax=p.amax,
+        leaf=p.leaf,
+        ctx_gid=p.ctx_gid,
+        ctx_max=p.ctx_max,
+    )
+
+
+def merge_slice_packed(
+    state: PackedStore,
+    sl,
+    kill_budget: int,
+    max_inserts: int | None = None,
+) -> MergeResult:
+    """:func:`~delta_crdt_ex_tpu.ops.binned.merge_slice` over the packed
+    layout: identical insert/kill/context math, but the 7 per-column
+    element scatters collapse into ONE ``[k, 8]`` vector scatter and the
+    kill pass reads entry rows as word-plane gathers. Returns a
+    :class:`MergeResult` whose ``state`` is a :class:`PackedStore`."""
+    L = state.num_buckets
+    B = state.bin_capacity
+    R = state.replica_capacity
+    u, s = sl.key.shape
+
+    v = _slice_view(state, sl)
+    valid, rows_safe, rows_clip = v.valid, v.rows_safe, v.rows_clip
+    gids, rdense, ldense = v.gids, v.rdense, v.ldense
+    ln, ln_clip, ins, need_ctx_gap = v.ln, v.ln_clip, v.ins, v.need_ctx_gap
+
+    # --- insert pass (s2 ∖ c1): ONE vector scatter at fill positions ----
+    ins_rank = jnp.cumsum(ins.astype(jnp.int32), axis=1) - 1
+    n_ins_row = jnp.sum(ins, axis=1, dtype=jnp.int32)
+    fill_rows = state.fill[rows_clip]
+    need_fill_compact = jnp.any(valid & (fill_rows + n_ins_row > B))
+    pos = fill_rows[:, None] + ins_rank  # [U, S] target bin slot
+
+    idx_dtype = jnp.int32 if L * B + u * s < 2**31 else jnp.int64
+    pad_idx = L * B + jnp.arange(u * s, dtype=idx_dtype).reshape(u, s)
+    flat = jnp.where(
+        ins & (pos < B),
+        rows_clip[:, None].astype(idx_dtype) * B + jnp.clip(pos, 0, B - 1),
+        pad_idx,
+    )
+    n_inserted = jnp.sum(ins.astype(jnp.int32))
+
+    if max_inserts is None:
+        need_ins_tier = jnp.bool_(False)
+        flat_c = flat.reshape(-1)
+        sel = slice(None)
+        sorted_hint = False
+    else:
+        k = min(max_inserts, flat.size)
+        neg_vals, sel = jax.lax.top_k(-flat.reshape(-1), k)
+        flat_c = -neg_vals
+        need_ins_tier = n_inserted > sel.shape[0]
+        sorted_hint = True
+
+    take = lambda a: a.reshape(-1)[sel]
+    key_c = take(sl.key)
+    valh_c = take(sl.valh)
+    ts_c = take(sl.ts)
+    ctr_c = take(sl.ctr)
+    ln_c = take(ln_clip).astype(jnp.int32)
+    node_c = take(jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1))
+    eh_c = entry_hash(key_c, _table_lookup(sl.ctx_gid, node_c), ctr_c, ts_c, valh_c)
+    ins_c = flat_c < L * B  # real inserts; padding indices scatter-drop
+    rows_c = (flat_c // B).astype(jnp.int32)
+
+    # the packed payload: [k, 8] word records, one scatter
+    meta_c = ln_c.astype(jnp.uint32) | (ins_c.astype(jnp.uint32) << jnp.uint32(16))
+    vals8 = jnp.concatenate(
+        [
+            _b32(key_c),  # [k, 2]
+            _b32(ts_c),  # [k, 2]
+            valh_c[:, None],
+            ctr_c[:, None],
+            eh_c[:, None],
+            meta_c[:, None],
+        ],
+        axis=-1,
+    )
+    words2 = (
+        state.words.reshape(L * B, _PLANES)
+        .at[flat_c]
+        .set(
+            vals8,
+            mode="drop",
+            unique_indices=sorted_hint,
+            indices_are_sorted=sorted_hint,
+        )
+        .reshape(L, B, _PLANES)
+    )
+
+    fill2 = state.fill.at[rows_safe].add(n_ins_row, mode="drop")
+    amin2 = state.amin.at[rows_c, ln_c].min(
+        jnp.where(ins_c, ctr_c, U32_MAX), mode="drop"
+    )
+    amax2 = state.amax.at[rows_c, ln_c].max(
+        jnp.where(ins_c, ctr_c, jnp.uint32(0)), mode="drop"
+    )
+    if max_inserts is None:
+        leaf_add = jnp.sum(
+            jnp.where(ins & (pos < B), eh_c.reshape(u, s), jnp.uint32(0)),
+            axis=1,
+            dtype=jnp.uint32,
+        )
+        leaf2 = state.leaf.at[rows_safe].add(leaf_add, mode="drop")
+    else:
+        leaf2 = state.leaf.at[rows_c].add(
+            jnp.where(ins_c, eh_c, jnp.uint32(0)), mode="drop"
+        )
+    ctx2 = state.ctx_max
+    for rr in range(sl.ctx_gid.shape[0]):
+        colr = jnp.where(gids.remap[rr] >= 0, gids.remap[rr], R)
+        vals_r = jnp.where(v.nonempty[:, rr], sl.ctx_rows[:, rr], jnp.uint32(0))
+        ctx2 = ctx2.at[rows_safe, colr].max(vals_r, mode="drop")
+
+    # --- kill pass ((s1∩s2) ∪ (s1∖c2)), pruned by amin/amax -------------
+    amin_rows = state.amin[rows_clip]
+    amax_rows = state.amax[rows_clip]
+    flagged = valid & jnp.any((rdense >= amin_rows) & (ldense < amax_rows), axis=1)
+    n_flagged = jnp.sum(flagged.astype(jnp.int32))
+    need_kill_tier = n_flagged > kill_budget
+
+    order = flagged_first_order(flagged, kill_budget)
+    kb = order.shape[0]
+    k_valid = flagged[order]
+    k_rows = jnp.where(k_valid, rows_clip[order], L)
+    k_rows_clip = jnp.clip(k_rows, 0, L - 1)
+
+    # local dots of the flagged rows, read as word-plane rows of the
+    # post-insert table (same read-through-inserts semantics as the
+    # column kernel)
+    w_rows = words2[k_rows_clip]  # [KB, B, 8]
+    meta_rows = w_rows[..., _META]
+    l_node = (meta_rows & jnp.uint32(0xFFFF)).astype(jnp.int32)
+    l_ctr = w_rows[..., 5]
+    alive_raw = (meta_rows >> jnp.uint32(16)) != 0
+    l_alive = alive_raw & k_valid[:, None]
+    l_ehash = w_rows[..., 6]
+
+    k_rdense = rdense[order]
+    k_ldense = ldense[order]
+    covered = (
+        jnp.take_along_axis(k_rdense, l_node, axis=1) >= l_ctr
+    ) & (jnp.take_along_axis(k_ldense, l_node, axis=1) < l_ctr)
+    r_node = ln_clip[order]
+    r_ctr = sl.ctr[order]
+    r_alive = sl.alive[order] & k_valid[:, None]
+    l_dot = encode_dot(l_node, l_ctr)
+    r_dot = jnp.where(r_alive, encode_dot(r_node, r_ctr), jnp.uint64(0))
+    present = jnp.any(l_dot[:, :, None] == r_dot[:, None, :], axis=2)
+
+    die = l_alive & covered & ~present
+    meta_new = (meta_rows & jnp.uint32(0xFFFF)) | (
+        (l_alive & ~die).astype(jnp.uint32) << jnp.uint32(16)
+    )
+    w_rows_new = jnp.concatenate(
+        [w_rows[..., : _META], meta_new[..., None]], axis=-1
+    )
+    words3 = words2.at[k_rows].set(w_rows_new, mode="drop")
+    leaf_sub = jnp.sum(jnp.where(die, l_ehash, jnp.uint32(0)), axis=1, dtype=jnp.uint32)
+    leaf3 = leaf2.at[k_rows].add(~leaf_sub + jnp.uint32(1), mode="drop")
+    amin_k = _row_amin(l_node, l_ctr, l_alive & ~die, kb, R)
+    amin3 = amin2.at[k_rows].set(amin_k, mode="drop")
+    amax_k = _row_amax(l_node, l_ctr, l_alive & ~die, kb, R)
+    amax3 = amax2.at[k_rows].set(amax_k, mode="drop")
+    n_killed = jnp.sum(die.astype(jnp.int32))
+
+    ok = ~(
+        gids.overflow
+        | need_kill_tier
+        | need_fill_compact
+        | need_ctx_gap
+        | need_ins_tier
+    )
+    new_state = PackedStore(
+        words=words3,
+        fill=fill2,
+        amin=amin3,
+        amax=amax3,
+        leaf=leaf3,
+        ctx_gid=gids.ctx_gid,
+        ctx_max=ctx2,
+    )
+    return MergeResult(
+        new_state,
+        ok,
+        gids.overflow,
+        need_kill_tier,
+        need_fill_compact,
+        need_ctx_gap,
+        need_ins_tier,
+        n_inserted,
+        n_killed,
+    )

@@ -1,0 +1,98 @@
+"""Packed-layout merge parity: ``merge_slice_packed`` (the roofline's
+single-vector-scatter A/B candidate, ``ops/packed.py``) must produce
+bit-identical lattice state to the column-layout ``merge_slice`` on
+every workload — inserts, interval kills, unknown writers, tier
+overflow flags. Also pins ``pack``/``unpack`` as bitwise inverses.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from delta_crdt_ex_tpu.ops.binned import extract_rows, merge_slice
+from delta_crdt_ex_tpu.ops.packed import merge_slice_packed, pack, unpack
+from delta_crdt_ex_tpu.utils.synth import build_state, interval_delta_stream
+from tests.kernel_harness import BinnedKernelMap
+from tests.test_merge_parity import assert_states_equal
+
+
+def roundtrip_columns(st):
+    return unpack(pack(st))
+
+
+def assert_bitwise_equal(s1, s2, ctx):
+    import dataclasses
+
+    for f in dataclasses.fields(type(s1)):
+        a, b = np.asarray(getattr(s1, f.name)), np.asarray(getattr(s2, f.name))
+        assert np.array_equal(a, b), (ctx, f.name)
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(3)
+    keys = rng.integers(1, 1 << 63, size=500, dtype=np.uint64)
+    st, _ = build_state(11, keys, num_buckets=32, bin_capacity=32)
+    assert_bitwise_equal(roundtrip_columns(st), st, "roundtrip")
+
+
+def test_packed_merge_parity_randomized():
+    rng = np.random.default_rng(4)
+    for trial in range(10):
+        L = 16
+        a = BinnedKernelMap(gid=100, capacity=128, rcap=4, num_buckets=L)
+        b = BinnedKernelMap(gid=200, capacity=128, rcap=4, num_buckets=L)
+        for ts in range(1, int(rng.integers(2, 25))):
+            who = a if rng.random() < 0.5 else b
+            k = int(rng.integers(0, 24))
+            op = rng.random()
+            if op < 0.7:
+                who.add(k, int(rng.integers(0, 100)), ts=ts)
+            elif op < 0.95:
+                who.remove(k, ts=ts)
+            else:
+                who.clear(ts=ts)
+        if rng.random() < 0.6:  # give kills remote targets
+            a.join_from(b)
+        sl = extract_rows(b.state, jnp.arange(L, dtype=jnp.int32))
+        for max_inserts in (None, 256):
+            r1 = merge_slice(a.state, sl, kill_budget=L, max_inserts=max_inserts)
+            r2 = merge_slice_packed(
+                pack(a.state), sl, kill_budget=L, max_inserts=max_inserts
+            )
+            ctx = (trial, max_inserts)
+            assert bool(r1.ok) == bool(r2.ok), ctx
+            assert_bitwise_equal(unpack(r2.state), r1.state, ctx)
+            assert_states_equal(unpack(r2.state), r1.state, ctx)
+            assert int(r1.n_inserted) == int(r2.n_inserted), ctx
+            assert int(r1.n_killed) == int(r2.n_killed), ctx
+
+
+def test_packed_interval_stream_parity():
+    rng = np.random.default_rng(5)
+    L = 64
+    keys = rng.integers(1, 1 << 63, size=2000, dtype=np.uint64)
+    st_col, _ = build_state(11, keys, num_buckets=L, bin_capacity=64)
+    st_pk = pack(st_col)
+    slices, _ = interval_delta_stream(22, rng, 6, 64, L, bin_width=8)
+    for i, sl in enumerate(slices):
+        r1 = merge_slice(st_col, sl, kill_budget=L, max_inserts=256)
+        r2 = merge_slice_packed(st_pk, sl, kill_budget=L, max_inserts=256)
+        assert bool(r1.ok) and bool(r2.ok), i
+        st_col, st_pk = r1.state, r2.state
+        assert_bitwise_equal(unpack(st_pk), st_col, i)
+        for fl in ("need_gid_grow", "need_kill_tier", "need_fill_compact",
+                   "need_ctx_gap", "need_ins_tier"):
+            assert bool(getattr(r1, fl)) == bool(getattr(r2, fl)), (i, fl)
+
+
+def test_packed_flags_parity_on_overflow():
+    # an insert tier too small must flag identically on both layouts
+    rng = np.random.default_rng(6)
+    L = 64
+    keys = rng.integers(1, 1 << 63, size=100, dtype=np.uint64)
+    st_col, _ = build_state(11, keys, num_buckets=L, bin_capacity=32)
+    slices, _ = interval_delta_stream(22, rng, 1, 64, L, bin_width=8)
+    sl = slices[0]
+    r1 = merge_slice(st_col, sl, kill_budget=L, max_inserts=8)
+    r2 = merge_slice_packed(pack(st_col), sl, kill_budget=L, max_inserts=8)
+    assert bool(r1.need_ins_tier) and bool(r2.need_ins_tier)
+    assert bool(r1.ok) == bool(r2.ok) == False  # noqa: E712
