@@ -51,10 +51,18 @@ _MSGZ = 3  # zlib-compressed _MSG — only sent to peers that advertised
 # _FEAT_MSGZ in the HELLO exchange (legacy peers get plain _MSG frames,
 # so mixed-version clusters keep converging; see MIGRATING.md)
 _HELLO = 4  # capability negotiation: payload = [wire_version, features]
+_MSGB = 5  # arrays side-channel: pickle-5 head + out-of-band buffers,
+# each buffer raw or zlib'd by a sampled compressibility probe. The DCN
+# data plane for sync slices (SURVEY §5.8): dense walk slices compress
+# only ~4.5x while zlib-1 costs ~16 ms/slice CPU (measured, BASELINE.md
+# "cross-host wire"), so whole-frame pickle4+zlib loses to raw framing
+# at any link >= 1 Gb/s; sparse delta slices still compress 25x+ and the
+# per-buffer probe keeps that win.
 
 _WIRE_VERSION = 1
 _FEAT_MSGZ = 1  # feature bit: peer accepts zlib-compressed _MSG frames
-_OUR_FEATURES = _FEAT_MSGZ
+_FEAT_MSGB = 2  # feature bit: peer accepts _MSGB array-buffer frames
+_OUR_FEATURES = _FEAT_MSGZ | _FEAT_MSGB
 
 #: how long the HELLO waiter keeps reading for a late reply before giving
 #: up (several socket timeouts — a loaded peer may accept late; a legacy
@@ -70,6 +78,97 @@ _COMPRESS_MIN = 4096
 
 def _send_frame(sock: socket.socket, kind: int, payload: bytes) -> None:
     sock.sendall(_LEN.pack(len(payload) + 1) + bytes([kind]) + payload)
+
+
+_BUF_HDR = struct.Struct(">BI")  # per-buffer: flags (1 = zlib), wire length
+_Z_SAMPLE = 1 << 12  # probe the first 4 KiB for compressibility
+
+
+def _maybe_z_buffer(mv: memoryview) -> "tuple[int, bytes | memoryview]":
+    """Per-buffer compression decision: compress only when a cheap
+    sample probe predicts a real win — padded (sparse) slice columns
+    shrink 25x+ and take the zlib path; dense columns ship raw instead
+    of paying ~8 ms/MiB for ~4.5x (measured; see _MSGB note)."""
+    n = mv.nbytes
+    if n >= _COMPRESS_MIN:
+        # probe head AND tail separately: wire tiers pad slices with
+        # TRAILING zero rows (pow4 rounding), so a head-only probe would
+        # read a dense prefix and miss exactly the padding it exists
+        # for, while a combined sample would dilute a padded tail's
+        # signal below the bar. Either a compressible-overall sample or
+        # a nearly-empty tail (the padded-slice signature) triggers the
+        # real attempt; the attempt keeps only a >=2x shrink.
+        mvb = mv.cast("B")
+        half = _Z_SAMPLE // 2
+        head = bytes(mvb[:half]) if n > half else bytes(mvb)
+        tail = bytes(mvb[-half:]) if n > 2 * half else b""
+        zh, zt = len(zlib.compress(head, 1)), len(zlib.compress(tail, 1))
+        if (zh + zt) * 3 <= len(head) + len(tail) or (
+            tail and zt * 8 <= len(tail)
+        ):
+            z = zlib.compress(mv, 1)
+            if len(z) * 2 <= n:
+                return 1, z
+    return 0, mv
+
+
+#: below this much raw buffer data the side-channel's per-buffer probe
+#: and framing overheads beat its copy savings — small (eager-delta)
+#: messages stay on the legacy whole-frame path, which also compresses
+#: cross-buffer redundancy better on mostly-padding slices (measured:
+#: 16-row push 0.49 ms legacy vs 0.89 ms framed; 512-row walk 6.1 ms
+#: framed vs 21.4 ms legacy — BASELINE.md "cross-host wire")
+_MSGB_MIN = 256 << 10
+
+
+def _encode_msgb(obj, min_bytes: int = 0) -> bytes | None:
+    """(head, buffers) wire form: pickle protocol 5 with out-of-band
+    buffers — the big numpy slice columns are framed as raw (or
+    probe-compressed) bytes instead of being copied through the pickle
+    stream and zlib'd wholesale. Returns None when the buffers hold
+    fewer than ``min_bytes`` (caller should use the legacy frame)."""
+    bufs: list[pickle.PickleBuffer] = []
+
+    def keep_oob(pb: pickle.PickleBuffer):
+        # pickle semantics: a FALSY return serializes out-of-band, a
+        # truthy one falls back to in-band (used for non-contiguous
+        # buffers, which can't be framed raw)
+        try:
+            pb.raw()
+        except BufferError:
+            return True
+        bufs.append(pb)
+        return False
+
+    head = pickle.dumps(obj, protocol=5, buffer_callback=keep_oob)
+    if sum(pb.raw().nbytes for pb in bufs) < min_bytes:
+        return None  # head dump is cheap; the legacy path re-pickles
+    parts = [struct.pack(">II", len(bufs), len(head)), head]
+    for pb in bufs:
+        flags, data = _maybe_z_buffer(pb.raw())
+        # raw buffers stay memoryviews here — join copies them exactly
+        # once (the source arrays outlive the call)
+        parts.append(_BUF_HDR.pack(flags, len(data)))
+        parts.append(data)
+    return b"".join(parts)
+
+
+def _decode_msgb(payload: bytes):
+    n_bufs, head_len = struct.unpack_from(">II", payload, 0)
+    off = 8
+    head = payload[off : off + head_len]
+    off += head_len
+    bufs = []
+    for _ in range(n_bufs):
+        flags, wire_len = _BUF_HDR.unpack_from(payload, off)
+        off += _BUF_HDR.size
+        data = payload[off : off + wire_len]
+        off += wire_len
+        # bytearray: reconstructed arrays must be WRITABLE like the
+        # legacy pickle4 paths' — handler behaviour must not depend on
+        # which negotiated wire path a message happened to take
+        bufs.append(bytearray(zlib.decompress(data)) if flags & 1 else bytearray(data))
+    return pickle.loads(head, buffers=bufs)
 
 
 def _recv_frame(sock: socket.socket) -> tuple[int, bytes] | None:
@@ -134,6 +233,7 @@ def _start_hello_negotiation(conn: "_SenderConn") -> None:
                 if ln >= 1 and body[0] == _HELLO:
                     if ln >= 3:
                         conn.accepts_z = bool(body[2] & _FEAT_MSGZ)
+                        conn.accepts_b = bool(body[2] & _FEAT_MSGB)
                     return  # a short/malformed HELLO concludes feature-less
                 # other frame kinds on an outbound conn are unexpected —
                 # skip and keep waiting for the HELLO
@@ -158,6 +258,8 @@ class _SenderConn:
         self.sock = sock
         #: negotiated via HELLO: whether this peer accepts _MSGZ frames
         self.accepts_z = accepts_z
+        #: negotiated via HELLO: whether this peer accepts _MSGB frames
+        self.accepts_b = False
         self._q: queue.Queue = queue.Queue(maxsize=self.QUEUE_MAX)
         self._on_dead = on_dead
         self._dead = False
@@ -362,10 +464,13 @@ class TcpTransport:
                 fresh = self._connect(endpoint)
                 if fresh is not None:
                     for k, p in retry:
+                        # renegotiated down (peer restarted on an older
+                        # build, or the fresh HELLO hasn't landed yet):
+                        # re-frame for the lowest common denominator
                         if k == _MSGZ and not fresh.accepts_z:
-                            # renegotiated down (peer restarted on an
-                            # older build): ship the frame uncompressed
                             k, p = _MSG, zlib.decompress(p)
+                        elif k == _MSGB and not fresh.accepts_b:
+                            k, p = _MSG, pickle.dumps(_decode_msgb(p), protocol=4)
                         fresh.enqueue(k, p, attempt=1)
 
         conn = _SenderConn(sock, on_dead)
@@ -391,10 +496,17 @@ class TcpTransport:
         conn = self._connect(endpoint)
         if conn is None:
             return False
-        payload = pickle.dumps(frame[1:], protocol=4)
         kind = frame[0]
-        # compression is a negotiated capability (HELLO), never assumed:
-        # a legacy peer without _FEAT_MSGZ gets plain frames
+        # both wire upgrades are negotiated capabilities (HELLO), never
+        # assumed: a legacy peer gets plain pickle4 frames
+        if kind == _MSG and conn.accepts_b:
+            # arrays side-channel: zero-copy buffer framing + per-buffer
+            # probe-gated compression (the DCN data plane for big
+            # slices); small messages fall through to the legacy frame
+            payload_b = _encode_msgb(frame[1:], min_bytes=_MSGB_MIN)
+            if payload_b is not None:
+                return conn.enqueue(_MSGB, payload_b)
+        payload = pickle.dumps(frame[1:], protocol=4)
         if kind == _MSG and conn.accepts_z and len(payload) >= _COMPRESS_MIN:
             z = zlib.compress(payload, 1)
             if len(z) < 0.9 * len(payload):  # keep incompressible frames raw
@@ -526,6 +638,9 @@ class TcpTransport:
                     self.send(name, msg)
                 elif kind == _MSGZ:
                     name, msg = pickle.loads(zlib.decompress(payload))
+                    self.send(name, msg)
+                elif kind == _MSGB:
+                    name, msg = _decode_msgb(payload)
                     self.send(name, msg)
                 elif not warned_unknown:
                     # once per connection: a misbehaving/newer peer

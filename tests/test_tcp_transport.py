@@ -154,10 +154,12 @@ def test_large_frames_compress_transparently():
         b.close()
 
 
-def test_hello_negotiates_compression():
+def test_hello_negotiates_array_side_channel():
     """The HELLO capability exchange flips the outbound connection to
-    compressed frames; a big frame sent after negotiation really travels
-    as _MSGZ (capability-gated — see MIGRATING.md rolling-upgrade note)."""
+    the arrays side-channel; a big array message sent after negotiation
+    really travels as _MSGB (capability-gated — see MIGRATING.md
+    rolling-upgrade note), and a peer negotiated down to MSGZ-only still
+    gets compressed pickle4 frames."""
     import numpy as np
 
     from delta_crdt_ex_tpu.runtime import tcp_transport as T
@@ -171,30 +173,82 @@ def test_hello_negotiates_compression():
         sent_kinds.append(kind)
         return orig(sock, kind, payload)
 
+    def pump(tag, n):
+        got = []
+        deadline = time.time() + 10
+        while len(got) < n and time.time() < deadline:
+            got.extend(b.drain("sink"))
+            time.sleep(0.02)
+        assert any(m["tag"] == tag for m in got), got
+        return got
+
     try:
         b.register("sink", None)
         # first send opens the connection and fires HELLO
         assert a.send(("sink", b.endpoint), {"tag": "opener"})
         conn = a._conns[b.endpoint]
         deadline = time.time() + 5
-        while not conn.accepts_z and time.time() < deadline:
+        while not (conn.accepts_z and conn.accepts_b) and time.time() < deadline:
             time.sleep(0.01)
-        assert conn.accepts_z, "HELLO reply never flipped the capability"
+        assert conn.accepts_z and conn.accepts_b, "HELLO never negotiated"
 
         T._send_frame = spy
-        big = {"arr": np.zeros((512, 64), np.uint64), "tag": "padded"}
+        big = {"arr": np.zeros((1024, 128), np.uint64), "tag": "padded"}
         assert a.send(("sink", b.endpoint), big)
-        got = []
-        deadline = time.time() + 10
-        while len(got) < 2 and time.time() < deadline:
-            got.extend(b.drain("sink"))
-            time.sleep(0.02)
-        assert any(m["tag"] == "padded" for m in got)
-        assert T._MSGZ in sent_kinds, "negotiated peer should get _MSGZ"
+        got = pump("padded", 2)
+        m = [g for g in got if g["tag"] == "padded"][0]
+        assert np.array_equal(m["arr"], big["arr"])
+        assert T._MSGB in sent_kinds, "negotiated peer should get _MSGB"
+
+        # peer downgraded to MSGZ-only (e.g. older build): big frames
+        # fall back to whole-frame compressed pickle4
+        conn.accepts_b = False
+        sent_kinds.clear()
+        assert a.send(("sink", b.endpoint), dict(big, tag="padded2"))
+        pump("padded2", 1)
+        assert T._MSGZ in sent_kinds and T._MSGB not in sent_kinds
     finally:
         T._send_frame = orig
         a.close()
         b.close()
+
+
+def test_msgb_encode_decode_roundtrip():
+    """Wire-format unit: dense buffers ship raw (probe says
+    incompressible), padded buffers ship zlib'd; both reconstruct
+    bit-identically, as do in-band small objects."""
+    import numpy as np
+
+    from delta_crdt_ex_tpu.runtime import tcp_transport as T
+
+    rng = np.random.default_rng(0)
+    dense = rng.integers(0, 2**63, (512, 128), dtype=np.uint64)
+    sparse = np.zeros((512, 128), np.uint64)
+    sparse[:, 0] = 7
+    obj = ("sink", {"dense": dense, "sparse": sparse, "meta": [1, "two", None]})
+    payload = T._encode_msgb(obj)
+    name, msg = T._decode_msgb(payload)
+    assert name == "sink"
+    assert np.array_equal(msg["dense"], dense)
+    assert np.array_equal(msg["sparse"], sparse)
+    assert msg["meta"] == [1, "two", None]
+    # handler behaviour must not depend on the wire path: _MSGB arrays
+    # are writable like the legacy pickle4 ones
+    assert msg["dense"].flags.writeable and msg["sparse"].flags.writeable
+    msg["dense"][0, 0] = 1  # must not raise
+    # a dense-head/padded-tail buffer (wire tiers pad at the END) must
+    # still be caught by the probe
+    padded = np.zeros(1 << 16, np.uint64)
+    padded[:2048] = rng.integers(0, 2**63, 2048, dtype=np.uint64)
+    assert T._maybe_z_buffer(memoryview(padded))[0] == 1
+    # the probe's two decisions really happened: the padded column
+    # compressed (wire < raw), the dense one did not (wire ~ raw + head)
+    raw_total = dense.nbytes + sparse.nbytes
+    assert len(payload) < raw_total * 0.6, "sparse buffer did not compress"
+    assert len(payload) > dense.nbytes, "dense buffer cannot compress below raw"
+    # per-buffer decision unit
+    assert T._maybe_z_buffer(memoryview(sparse.reshape(-1)))[0] == 1
+    assert T._maybe_z_buffer(memoryview(dense.reshape(-1)))[0] == 0
 
 
 def test_legacy_peer_never_receives_compressed_frames():
