@@ -34,7 +34,7 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
 
 __all__ = [
     "AWLWWMap",

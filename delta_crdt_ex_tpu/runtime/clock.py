@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import time
 
+import numpy as np
+
 
 class Clock:
     """Strictly increasing nanosecond timestamps, wall-clock based."""
@@ -24,6 +26,17 @@ class Clock:
         now = time.time_ns()
         self._last = now if now > self._last else self._last + 1
         return self._last
+
+    def next_n(self, n: int) -> np.ndarray:
+        """``n`` strictly increasing stamps in one call (the bulk flush
+        path) — consecutive from max(now, last+1), so interleaving with
+        ``next()`` keeps the strict global order."""
+        now = time.time_ns()
+        start = now if now > self._last else self._last + 1
+        out = start + np.arange(n, dtype=np.int64)
+        if n:
+            self._last = int(out[-1])
+        return out
 
     def observe(self, ts: int) -> None:
         """Fast-forward past a restored/remote timestamp (crash-restart
@@ -38,3 +51,8 @@ class LogicalClock(Clock):
     def next(self) -> int:
         self._last += 1
         return self._last
+
+    def next_n(self, n: int) -> np.ndarray:
+        out = self._last + 1 + np.arange(n, dtype=np.int64)
+        self._last += n
+        return out

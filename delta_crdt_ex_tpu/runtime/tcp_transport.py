@@ -253,6 +253,11 @@ class _SenderConn:
     backpressure loss only delays convergence)."""
 
     QUEUE_MAX = 256
+    #: byte bound alongside the frame-count bound: raw-framed _MSGB
+    #: slices are ~2x their zlib'd size, so a stalled peer must cap on
+    #: BYTES queued, not just frames (drops are safe — anti-entropy is
+    #: idempotent and the periodic sync re-covers)
+    QUEUE_MAX_BYTES = 64 << 20
 
     def __init__(self, sock: socket.socket, on_dead, accepts_z: bool = False) -> None:
         self.sock = sock
@@ -260,6 +265,7 @@ class _SenderConn:
         self.accepts_z = accepts_z
         #: negotiated via HELLO: whether this peer accepts _MSGB frames
         self.accepts_b = False
+        self._q_bytes = 0  # approximate: adjusted under _dead_lock only
         self._q: queue.Queue = queue.Queue(maxsize=self.QUEUE_MAX)
         self._on_dead = on_dead
         self._dead = False
@@ -274,8 +280,11 @@ class _SenderConn:
         with self._dead_lock:
             if self._dead:
                 return False
+            if self._q_bytes + len(payload) > self.QUEUE_MAX_BYTES:
+                return False  # byte cap: dropped; periodic sync will retry
             try:
                 self._q.put_nowait((kind, payload, attempt))
+                self._q_bytes += len(payload)
                 return True
             except queue.Full:
                 return False  # dropped; periodic sync will retry
@@ -295,6 +304,8 @@ class _SenderConn:
             item = self._q.get()
             if item is None:
                 return
+            with self._dead_lock:
+                self._q_bytes -= len(item[1])
             try:
                 _send_frame(self.sock, item[0], item[1])
             except OSError:
