@@ -47,6 +47,7 @@ __all__ = [
     "child_spec",
     "mutate",
     "mutate_async",
+    "mutate_batch",
     "read",
     "set_neighbours",
     "start_link",
@@ -68,6 +69,7 @@ _EXPORTS = {
     "child_spec": ("delta_crdt_ex_tpu.api", "child_spec"),
     "mutate": ("delta_crdt_ex_tpu.api", "mutate"),
     "mutate_async": ("delta_crdt_ex_tpu.api", "mutate_async"),
+    "mutate_batch": ("delta_crdt_ex_tpu.api", "mutate_batch"),
     "read": ("delta_crdt_ex_tpu.api", "read"),
     "set_neighbours": ("delta_crdt_ex_tpu.api", "set_neighbours"),
     "start_link": ("delta_crdt_ex_tpu.api", "start_link"),

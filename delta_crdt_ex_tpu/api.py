@@ -100,6 +100,15 @@ def mutate_async(crdt: Replica, f: str, args: list) -> None:
     crdt.mutate_async(f, args)
 
 
+def mutate_batch(
+    crdt: Replica, f: str, items: list, timeout: float = DEFAULT_TIMEOUT
+) -> None:
+    """Bulk mutation (TPU-native extension — no reference analog): one
+    ``f`` op per ``items`` entry, applied in order in vectorized batch
+    kernels. The natural shape for loads/imports."""
+    crdt.mutate_batch(f, items, timeout)
+
+
 def read(crdt: Replica, timeout: float = DEFAULT_TIMEOUT) -> "dict[Any, Any] | set":
     """Resolved read: a dict for map models, a set for ``AWSet``."""
     return crdt.read(timeout)

@@ -96,3 +96,24 @@ def test_sync_round_telemetry_reports_merge(transport, shared_clock):
         assert any(r["entries"] >= 1 for r in rounds)
     finally:
         telemetry.detach(telemetry.SYNC_ROUND, rec)
+
+
+def test_mutate_batch_matches_per_op(transport, shared_clock):
+    from delta_crdt_ex_tpu.api import mutate_batch
+
+    a = mk(transport, shared_clock, capacity=256)
+    b = mk(transport, shared_clock, capacity=256)
+    items = [[f"k{i}", i] for i in range(100)]
+    mutate_batch(a, "add", items)
+    for args in items:
+        b.mutate("add", args)
+    assert a.read() == b.read() == {f"k{i}": i for i in range(100)}
+    mutate_batch(a, "remove", [[f"k{i}"] for i in range(0, 100, 2)])
+    assert a.read() == {f"k{i}": i for i in range(1, 100, 2)}
+    # a rejected batch must not partially commit (not even later)
+    before = a.read()
+    with pytest.raises(ValueError, match="expects"):
+        mutate_batch(a, "add", [["ok", 1], ["bad-arity"]])
+    assert a.read() == before
+    a.stop()
+    b.stop()
