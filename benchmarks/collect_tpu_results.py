@@ -57,22 +57,18 @@ def main():
             out.append("  WARNING: GROUP=1 secondary tripped its overflow assertion")
         chip_success = not fallback
 
-    pk = _load("/tmp/northstar_packed.json")
-    if pk is None:
-        out.append("packed A/B: no artifact (matrix predates it or run skipped)")
-    elif "error" in pk:
-        out.append(f"packed A/B: RUN FAILED — {pk.get('metric')}: {pk.get('error')}")
-    else:
-        base = ns.get("value") if ns and "error" not in ns else None
-        cmp = (
-            f" — {pk.get('value') / base:.2f}x vs columns"
-            if base
-            else ""
-        )
-        out.append(
-            f"packed A/B: {pk.get('value')} merges/sec (layout="
-            f"{pk.get('layout')}){cmp} — promote ops/packed.py if it wins"
-        )
+    if ns is not None and "error" not in ns:
+        cols = ns.get("columns_merges_per_sec")
+        pkd = ns.get("packed_merges_per_sec")
+        if cols and pkd:
+            out.append(
+                f"layout A/B (same run): columns {cols} vs packed {pkd} "
+                f"merges/sec ({pkd / cols:.2f}x) — winner '{ns.get('layout')}' "
+                "is the headline value; promote ops/packed.py as the default "
+                "layout if packed wins on chip"
+            )
+        else:
+            out.append("layout A/B: fields absent (BENCH_AB=0 or pre-A/B artifact)")
 
     rows = []
     for path in sorted(glob.glob(os.path.join(REPO, "benchmarks", "results", "*.tpu.json"))):

@@ -43,17 +43,13 @@ else
   say "north-star FAILED: $NORTH_LINE (see $LOG)"
 fi
 
-say "packed-layout A/B (the roofline's vector-scatter lever; parity-pinned)"
-BENCH_PACKED=1 BENCH_TOTAL_BUDGET=2200 BENCH_CLAIM_TIMEOUT=120 \
-BENCH_CLAIM_ATTEMPTS=2 BENCH_TPU_TIMEOUT=2000 BENCH_NO_CPU_FALLBACK=1 \
-  timeout 2400 python bench.py > /tmp/northstar_packed.json 2>>"$LOG"
-PACKED_LINE=$(tail -1 /tmp/northstar_packed.json 2>/dev/null)
-if ok_line "$PACKED_LINE"; then
-  say "north-star (packed): $PACKED_LINE"
-  say "A/B columns-vs-packed: $NORTH_LINE | $PACKED_LINE"
-else
-  say "north-star (packed) FAILED: $PACKED_LINE (see $LOG)"
-fi
+# the north-star run above already A/Bs both merge layouts in-process
+# (BENCH_AB defaults on; the artifact line carries columns_/packed_
+# merges_per_sec and headlines the winner) — no second full run needed
+case "$NORTH_LINE" in
+  *packed_merges_per_sec*) say "layout A/B captured in the north-star line";;
+  *) say "WARNING: north-star line has no layout A/B fields";;
+esac
 
 say "merge-part probes (scatter/gather packing attribution)"
 timeout 1800 python -m benchmarks.profile_merge_parts >>"$LOG" 2>&1 \
