@@ -38,6 +38,7 @@ exit path (deadline exhaustion, claim failure, child crash, SIGTERM)
 from __future__ import annotations
 
 import json
+import math
 import os
 import signal
 import sys
@@ -50,9 +51,11 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 SMOKE = os.environ.get("BENCH_SMOKE") == "1"
 #: A/B switch for the packed-entry layout (ops/packed.py — the
 #: roofline's single-vector-scatter lever). Parity-pinned to the column
-#: kernel; expected to LOSE on CPU, decided by chip numbers
-#: (BASELINE.md "Merge-kernel roofline").
-PACKED = os.environ.get("BENCH_PACKED") == "1"
+#: kernel; PROMOTED to the default after the 2026-07-31 chip A/B
+#: measured packed 8,852.8 vs columns 4,211.9 merges/s (2.1×, past the
+#: ≥1.2× promotion bar; CPU full-config is a wash — BASELINE.md
+#: "Merge-kernel roofline"). BENCH_PACKED=0 times columns as primary.
+PACKED = os.environ.get("BENCH_PACKED", "1") == "1"
 
 N_KEYS = 4096 if SMOKE else 1_000_000
 # geometry: load ≈ N_KEYS/L per bucket; bin capacity must clear the
@@ -68,7 +71,7 @@ DELTA = 128 if SMOKE else 512  # the merge unit: one 512-entry delta slice
 #: This amortises fixed per-call dispatch. Buffer donation already keeps
 #: the merge O(slice) — 16× the capacity costs 1.11× per call
 #: (BASELINE.md "O(slice) merge evidence").
-GROUP = 4 if SMOKE else 16
+GROUP = int(os.environ.get("BENCH_GROUP", "0")) or (4 if SMOKE else 16)
 CALLS = 2 if SMOKE else 6  # timed calls
 WARMUP_CALLS = 1
 RCAP = 8
@@ -124,11 +127,25 @@ def bench_tpu(seed=0, on_primary=None):
     # per device call (a group of GROUP in-order 512-entry interval
     # deltas concatenates into one exact interval slice), fresh dots.
     # bin_width bounds per-bucket slice occupancy; at the full config the
-    # per-delta bucket load is λ = 0.5, so 8 clears the Poisson tail with
-    # huge margin and halves every per-entry grid vs 16 (the smoke config
-    # runs λ = 2 and keeps 16)
+    # per-delta bucket load is λ = GROUP·DELTA/L (0.5 at GROUP=16), and
+    # the width must clear the Poisson tail for the whole run (the stream
+    # generator raises on overflow) — λ + 6√λ + 2 keeps the per-run
+    # slice-overflow odds negligible at any BENCH_GROUP (the floor keeps
+    # the default geometries at their measured widths: smoke 16, full 8).
+    # BENCH_GROUP is a dispatch-amortization knob, not a free axis: the
+    # run's TOTAL inserts still land in BIN_CAP-slot bins, so warn when
+    # the end-of-run occupancy tail approaches capacity (the overflow
+    # assertion in timed_group_run would fail the run honestly).
     _stage("delta stream generation…")
-    bw = 16 if SMOKE else 8
+    lam = GROUP * DELTA / L
+    bw = max(16 if SMOKE else 8, math.ceil(lam + 6 * math.sqrt(lam) + 2))
+    lam_end = N_KEYS / L + (WARMUP_CALLS + CALLS + 1) * GROUP * DELTA / L
+    if lam_end + 6 * math.sqrt(lam_end) > BIN_CAP:
+        log(
+            f"WARNING: end-of-run bucket load {lam_end:.1f} + tail exceeds "
+            f"bin capacity {BIN_CAP}; expect fill-overflow assertions at "
+            f"this BENCH_GROUP"
+        )
     next_ctr = None
     calls = []
     for _ in range(WARMUP_CALLS + CALLS):
