@@ -33,7 +33,11 @@ from delta_crdt_ex_tpu.utils.synth import build_state, interval_delta_stream
 N_KEYS = 1_000_000
 TREE_DEPTH = 14
 BIN_CAP = 128
-NEIGHBOURS = 64
+# 64 is the bench fan-in, but at 64 the standalone gather probes alloc
+# ~6 GiB of device arrays on top of the broadcast state stack and the
+# first chip session wedged for its full 30-min timeout; per-neighbour
+# numbers are width-independent, so default to a width that fits easily.
+NEIGHBOURS = int(os.environ.get("MERGE_PARTS_NEIGHBOURS", "16"))
 DELTA = 512
 GROUP = 16
 RCAP = 8
@@ -76,21 +80,21 @@ def main():
         )
         return res.state.leaf, res.ok
 
-    log(f"merge_slice x64: {timed(lambda: f_slice(stacked, sl))*1e3:.1f} ms")
+    log(f"merge_slice x{NEIGHBOURS}: {timed(lambda: f_slice(stacked, sl))*1e3:.1f} ms")
 
     @jax.jit
     def f_rows(states, s):
         res = jax.vmap(merge_rows, in_axes=(0, None))(states, s)
         return res.state.leaf, res.ok
 
-    log(f"merge_rows  x64: {timed(lambda: f_rows(stacked, sl))*1e3:.1f} ms")
+    log(f"merge_rows  x{NEIGHBOURS}: {timed(lambda: f_rows(stacked, sl))*1e3:.1f} ms")
 
     @jax.jit
     def f_view(states, s):
         v = jax.vmap(lambda st: _slice_view(st, s))(states)
         return v.ins, v.rdense
 
-    log(f"_slice_view x64: {timed(lambda: f_view(stacked, sl))*1e3:.1f} ms")
+    log(f"_slice_view x{NEIGHBOURS}: {timed(lambda: f_view(stacked, sl))*1e3:.1f} ms")
 
     # element scatters alone: one column, full 8192-entry compacted scatter
     u, s_w = sl.key.shape
@@ -109,7 +113,7 @@ def main():
             )
         return jax.vmap(one_col)(states.ctr, flat)
 
-    log(f"1-col scatter x64: {timed(lambda: f_scatter(stacked, sl))*1e3:.1f} ms")
+    log(f"1-col scatter x{NEIGHBOURS}: {timed(lambda: f_scatter(stacked, sl))*1e3:.1f} ms")
 
     # does one vector-valued scatter amortise the per-index cost that 7
     # scalar-column scatters pay separately? (informs a packed-layout
@@ -151,7 +155,7 @@ def main():
             jnp.broadcast_to(s.key.reshape(-1), (NEIGHBOURS, u * s_w)), axis=1
         )
 
-    log(f"argsort 8192 x64: {timed(lambda: f_sort(sl))*1e3:.1f} ms")
+    log(f"argsort 8192 x{NEIGHBOURS}: {timed(lambda: f_sort(sl))*1e3:.1f} ms")
 
     # gather-packing probe (mirror of the scatter probe): merge_slice's
     # compacted branch pays 6 per-column take() gathers at the same
@@ -172,7 +176,7 @@ def main():
         return (f(ck), f(cts)) + tuple(f(c) for c in c32)
 
     log(
-        f"6 scalar gathers @ {E} idx x64: "
+        f"6 scalar gathers @ {E} idx x{NEIGHBOURS}: "
         f"{timed(lambda: f_gather_scalar(ck, cts, c32))*1e3:.1f} ms"
     )
 
@@ -192,7 +196,7 @@ def main():
         )
 
     log(
-        f"1 stacked [E,8] gather @ {E} idx x64: "
+        f"1 stacked [E,8] gather @ {E} idx x{NEIGHBOURS}: "
         f"{timed(lambda: f_gather_stacked(ck, cts, c32))*1e3:.1f} ms"
     )
 
@@ -206,7 +210,7 @@ def main():
             states.alive[:, rows_clip],
         )
 
-    log(f"row gather x64: {timed(lambda: f_gather(stacked, sl))*1e3:.1f} ms")
+    log(f"row gather x{NEIGHBOURS}: {timed(lambda: f_gather(stacked, sl))*1e3:.1f} ms")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,67 @@
+#!/bin/bash
+# Resume a TPU matrix session that died partway (container restart wiped
+# /tmp mid-run on 2026-07-31: smoke + north-star landed, the harness
+# rows did not). Runs ONLY the steps whose artifacts are missing,
+# most-valuable-first, so another mid-session death still accretes
+# evidence. Safe to re-run: each step is skipped once its
+# benchmarks/results/*.tpu.json exists.
+#
+# Usage: bash benchmarks/resume_tpu_matrix.sh [logfile]
+set -u
+cd "$(dirname "$0")/.."
+LOG="${1:-benchmarks/results/tpu_resume.log}"
+say() { echo "[tpu-resume $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+run_row() { # name timeout module [env...]
+  local name="$1" tmo="$2" mod="$3"; shift 3
+  if [ -f "benchmarks/results/${name}.tpu.json" ]; then
+    say "$name: artifact exists, skipping"
+    return 0
+  fi
+  say "$name: running (timeout ${tmo}s)"
+  if env "$@" timeout "$tmo" python -m "$mod" >>"$LOG" 2>&1; then
+    say "$name done"
+  else
+    say "$name FAILED (rc=$?)"
+  fi
+}
+
+say "resume session start; devices probe:"
+timeout 120 python -c "import jax; print(jax.devices())" >>"$LOG" 2>&1 \
+  || { say "chip unreachable, aborting"; exit 1; }
+
+run_row basic_operations 1800 benchmarks.basic_operations
+run_row propagation 1800 benchmarks.propagation
+run_row propagation_devplane 1800 benchmarks.propagation PROP_DEVICE_PLANE=1
+run_row ring_bench 1800 benchmarks.ring_bench
+run_row full_bench 2400 benchmarks.full_bench
+run_row mesh_gossip 1200 benchmarks.mesh_gossip
+
+say "graft entry compile check (single chip)"
+timeout 900 python -c "
+import __graft_entry__ as g, jax
+fn, args = g.entry()
+out = jax.jit(fn)(*args)
+jax.block_until_ready(out)
+print('entry ok:', jax.devices())
+" >>"$LOG" 2>&1 && say "entry compile OK" || say "entry compile FAILED"
+
+# last because it timed out at 1800s in the first session (the 64-wide
+# gather probes alloc ~6 GiB on-device); run at reduced width so a hang
+# costs 900s not 30min and the arrays fit comfortably
+if grep -q "merge-parts done" "$LOG" 2>/dev/null; then
+  say "profile_merge_parts: already done, skipping"
+else
+  say "profile_merge_parts: running at N=16 (timeout 900s)"
+  if MERGE_PARTS_NEIGHBOURS=16 timeout 900 python -m benchmarks.profile_merge_parts >>"$LOG" 2>&1; then
+    say "profile_merge_parts done"; echo "merge-parts done" >>"$LOG"
+  else
+    say "profile_merge_parts FAILED (rc=$?)"
+  fi
+fi
+
+say "collecting digest"
+timeout 300 python -m benchmarks.collect_tpu_results "$LOG" \
+  >> benchmarks/results/tpu_digest.txt 2>&1 \
+  && say "digest written" || say "digest FAILED"
+say "resume session complete"

@@ -10,16 +10,21 @@ a ~7× cut of the merge's dominant random-access term (the roofline's
 13.9k → ~50k merges/s ceiling move). The read side pays bitcast/unpack
 vector ops, which XLA fuses into consumers.
 
-This module is the pre-staged A/B candidate, NOT the default engine:
+This module is the PROMOTED fast path for bulk fan-in merges (chip A/B
+2026-07-31: packed 8,852.8 vs columns 4,211.9 merges/s at the full
+north-star config — 2.10×, past the 1.2× promotion bar; BASELINE.md
+"Merge-kernel roofline"):
 
 - ``merge_slice_packed`` is bit-parity tested against ``merge_slice``
-  (``tests/test_packed_parity.py``) over randomized workloads;
-- the north-star bench runs it with ``BENCH_PACKED=1`` (``bench.py``),
-  and ``benchmarks/run_tpu_matrix.sh`` A/Bs both layouts in one chip
-  window;
-- CPU numbers are expected to LOSE (the probe measured plane
-  materialisation overwhelming the saved index entries there) — only a
-  chip measurement green-lights promotion to the default layout.
+  (``tests/test_packed_parity.py``) over randomized workloads,
+  including tier growth through the shared escalation ladder;
+- ``bench.py`` times it as the primary layout (``BENCH_PACKED=0``
+  reverts to columns) and still A/Bs both layouts in one run;
+- the library fan-out path accepts packed stacks
+  (``parallel/batched_sync.py::fanout_merge_into``);
+- the full-config CPU A/B measured a wash (the micro-probe's predicted
+  CPU loss does not materialise once XLA fuses the whole call), so the
+  promotion carries no CPU downside.
 
 Plane layout (all uint32): ``[key_lo, key_hi, ts_lo, ts_hi, valh, ctr,
 ehash, meta]`` with ``meta = node | alive << 16`` (writer slots are
@@ -46,6 +51,7 @@ from delta_crdt_ex_tpu.ops.binned import (
     _row_amax,
     _slice_view,
     _table_lookup,
+    compact_rows,
     encode_dot,
     entry_hash,
     flagged_first_order,
@@ -85,6 +91,20 @@ class PackedStore:
     @property
     def replica_capacity(self) -> int:
         return self.ctx_gid.shape[-1]
+
+    def grow(
+        self, bin_capacity: int | None = None, replica_capacity: int | None = None
+    ) -> "PackedStore":
+        """Pad to a larger tier — the packed analog of
+        :meth:`BinnedStore.grow`, so :func:`tier_retry_merge` escalates
+        either layout through the same policy. Growth is a
+        fresh-jit-compile event already, so the round-trip through the
+        column layout costs nothing that matters."""
+        return pack(
+            unpack(self).grow(
+                bin_capacity=bin_capacity, replica_capacity=replica_capacity
+            )
+        )
 
 
 def _b32(a) -> jax.Array:
@@ -139,6 +159,14 @@ def unpack(p: PackedStore) -> BinnedStore:
         ctx_gid=p.ctx_gid,
         ctx_max=p.ctx_max,
     )
+
+
+def compact_rows_packed(p: PackedStore) -> PackedStore:
+    """:func:`~delta_crdt_ex_tpu.ops.binned.compact_rows` over the packed
+    layout (unpack → dense repack → pack: compaction is a rare
+    whole-table event triggered by ``need_fill_compact``, so the layout
+    round-trip is noise next to the repack itself)."""
+    return pack(compact_rows(unpack(p)))
 
 
 def merge_slice_packed(

@@ -1,6 +1,8 @@
 from delta_crdt_ex_tpu.parallel.batched_sync import (
     fanout_merge,
     fanout_merge_into,
+    fanout_merge_packed,
+    pack_states,
     ring_gossip_round,
     stack_states,
     unstack_states,
@@ -21,10 +23,12 @@ __all__ = [
     "AXIS",
     "fanout_merge",
     "fanout_merge_into",
+    "fanout_merge_packed",
     "gossip_delta_drive",
     "gossip_delta_step",
     "gossip_train_step",
     "make_mesh",
+    "pack_states",
     "place_states",
     "replica_sharding",
     "restore_mesh",

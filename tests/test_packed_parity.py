@@ -84,6 +84,72 @@ def test_packed_interval_stream_parity():
             assert bool(getattr(r1, fl)) == bool(getattr(r2, fl)), (i, fl)
 
 
+def test_packed_fanout_parity_with_growth():
+    """The promoted fan-out path (``fanout_merge_into`` over a
+    ``PackedStore`` stack) must walk the SAME tier-escalation ladder as
+    the column stack — same retry count, same final tiers — and land
+    bit-identical lattice state, on a workload that overflows the kill
+    budget, the bin tier, and the gid table at once (the
+    ``test_fanout_tier_overflow_converges_and_bounds_retries``
+    scenario)."""
+    import jax.numpy as jnp
+
+    from delta_crdt_ex_tpu.ops.binned import extract_rows as _extract
+    from delta_crdt_ex_tpu.parallel import (
+        fanout_merge_into,
+        pack_states,
+        stack_states,
+        unstack_states,
+    )
+    from tests.test_parallel import fresh_states
+
+    n, L = 8, 16
+    origin = BinnedKernelMap(gid=500, capacity=64, rcap=2, num_buckets=L)
+    for k in range(32):
+        origin.add(k, k, ts=k + 1)
+    neighbours = fresh_states(n, capacity=64, rcap=2, num_buckets=L)
+    for m in neighbours:
+        m.join_from(origin)
+    stacked = stack_states([m.state for m in neighbours])
+
+    updater = BinnedKernelMap(gid=999, capacity=64, rcap=4, num_buckets=L)
+    updater.join_from(origin)
+    for k in range(32):
+        updater.remove(k, ts=100 + k)
+    for j in range(48):
+        updater.add(32 + j, 7000 + j, ts=200 + j)
+    sl = _extract(updater.state, jnp.arange(L, dtype=jnp.int32))
+
+    col2, col_res, col_retries = fanout_merge_into(stacked, sl, kill_budget=2)
+    pk2, pk_res, pk_retries = fanout_merge_into(
+        pack_states(stacked), sl, kill_budget=2
+    )
+    assert bool(col_res.ok.all()) and bool(pk_res.ok.all())
+    assert col_retries == pk_retries and col_retries >= 1
+    assert pk2.bin_capacity == col2.bin_capacity >= 8
+    assert pk2.replica_capacity == col2.replica_capacity >= 4
+    assert_bitwise_equal(unpack(pk2), col2, "fanout growth")
+    for col_st, pk_st in zip(unstack_states(col2), unstack_states(unpack(pk2))):
+        assert_states_equal(pk_st, col_st, "per-neighbour")
+
+
+def test_packed_grow_and_compact_roundtrip():
+    rng = np.random.default_rng(7)
+    keys = rng.integers(1, 1 << 63, size=500, dtype=np.uint64)
+    st, _ = build_state(11, keys, num_buckets=32, bin_capacity=32)
+    grown = pack(st).grow(bin_capacity=64, replica_capacity=8)
+    assert grown.bin_capacity == 64 and grown.replica_capacity == 8
+    assert_bitwise_equal(
+        unpack(grown), st.grow(bin_capacity=64, replica_capacity=8), "grow"
+    )
+    from delta_crdt_ex_tpu.ops.binned import compact_rows
+    from delta_crdt_ex_tpu.ops.packed import compact_rows_packed
+
+    assert_bitwise_equal(
+        unpack(compact_rows_packed(pack(st))), compact_rows(st), "compact"
+    )
+
+
 def test_packed_flags_parity_on_overflow():
     # an insert tier too small must flag identically on both layouts
     rng = np.random.default_rng(6)
