@@ -56,6 +56,12 @@ SMOKE = os.environ.get("BENCH_SMOKE") == "1"
 #: ≥1.2× promotion bar; CPU full-config is a wash — BASELINE.md
 #: "Merge-kernel roofline"). BENCH_PACKED=0 times columns as primary.
 PACKED = os.environ.get("BENCH_PACKED", "1") == "1"
+#: A/B switch for the fused-aux packed kernel (amin/amax/ctx as one
+#: [L,R,3] min-scatter via the unsigned-complement identity, fill/leaf
+#: as one [k,2] add-scatter — ~25% fewer random-access index entries).
+#: Pre-staged candidate: BENCH_FUSED=1 times it as primary and the A/B
+#: alternate becomes the plain packed kernel, so one chip run decides.
+FUSED = PACKED and os.environ.get("BENCH_FUSED", "0") == "1"
 
 N_KEYS = 4096 if SMOKE else 1_000_000
 # geometry: load ≈ N_KEYS/L per bucket; bin capacity must clear the
@@ -164,13 +170,21 @@ def bench_tpu(seed=0, on_primary=None):
 
     merge_fn = merge_slice
     if PACKED:
-        from delta_crdt_ex_tpu.ops.packed import merge_slice_packed, pack
+        from delta_crdt_ex_tpu.ops.packed import (
+            merge_slice_packed,
+            merge_slice_packed_fused,
+            pack,
+        )
 
         _stage("packing entry columns (BENCH_PACKED=1)…")
         stacked = jax.jit(pack)(stacked)
         jax.block_until_ready(stacked)
-        merge_fn = merge_slice_packed
-        log("merge layout: packed (one vector scatter per insert)")
+        merge_fn = merge_slice_packed_fused if FUSED else merge_slice_packed
+        log(
+            "merge layout: packed, fused aux scatters"
+            if FUSED
+            else "merge layout: packed (one vector scatter per insert)"
+        )
 
     merges = CALLS * GROUP * NEIGHBOURS
 
@@ -274,8 +288,13 @@ def bench_tpu(seed=0, on_primary=None):
             _stage("alternate-layout A/B…")
             from delta_crdt_ex_tpu.ops.packed import merge_slice_packed, pack
 
-            alt_name = "columns" if PACKED else "packed"
-            alt_fn = merge_slice if PACKED else merge_slice_packed
+            if FUSED:
+                # fused primary → the A/B isolates the fusion itself
+                alt_name, alt_fn = "packed_unfused", merge_slice_packed
+            elif PACKED:
+                alt_name, alt_fn = "columns", merge_slice
+            else:
+                alt_name, alt_fn = "packed", merge_slice_packed
             # free the primary run's states before building the second
             # stack: two full neighbour stacks would not fit HBM together
             st = st1 = None
@@ -283,14 +302,17 @@ def bench_tpu(seed=0, on_primary=None):
                 lambda x: jnp.copy(jnp.broadcast_to(x, (NEIGHBOURS,) + x.shape)),
                 one,
             )
-            if not PACKED:
+            if alt_fn is not merge_slice:
                 base = jax.jit(pack, donate_argnums=(0,))(base)
             jax.block_until_ready(base)
             _st2, dt2 = timed_group_run(alt_fn, base)
             alt = (alt_name, merges / dt2)
+            primary_name = (
+                "packed_fused" if FUSED else ("packed" if PACKED else "columns")
+            )
             log(
                 f"A/B: {alt_name} {merges / dt2:.1f} vs "
-                f"{'packed' if PACKED else 'columns'} {merges / dt:.1f} merges/sec"
+                f"{primary_name} {merges / dt:.1f} merges/sec"
             )
         except AssertionError as e:
             log(f"alternate-layout A/B overflowed a tier — ignored: {e!r}")
@@ -692,7 +714,7 @@ def _main_measured(budget: Budget, fallback_reserve: float, run_state: dict):
             raise SystemExit("bench failed on accelerator AND cpu")
 
     value = float(res["merges_per_sec"])
-    layout = "packed" if PACKED else "columns"
+    layout = "packed_fused" if FUSED else ("packed" if PACKED else "columns")
     line = {
         "metric": _metric_name(run_state["fallback"]),
         "unit": "merges/sec",

@@ -212,6 +212,83 @@ def main():
 
     log(f"row gather x{NEIGHBOURS}: {timed(lambda: f_gather(stacked, sl))*1e3:.1f} ms")
 
+    # --- probes that decide the NEXT packed-kernel lever ----------------
+    # (added after the 2026-07-31 chip session: packed measures ~116 ms/
+    # call vs a ~62 ms roofline+dispatch estimate — who eats the rest?)
+
+    # (1) insert compaction: merge_slice's per-neighbour top_k over the
+    # [u*s]=65,536-slot grid. If this costs more than the ~0.57 ms/
+    # neighbour a full-grid scatter would add, the compaction (and the
+    # whole need_ins_tier ladder) is a net loss on chip.
+    grid = jnp.asarray(
+        rng.integers(0, L * B, (NEIGHBOURS, u * s_w), np.int64)
+    )
+
+    @jax.jit
+    def f_topk(g):
+        nv, sel = jax.lax.top_k(-g, 8192)
+        return nv, sel
+
+    log(
+        f"top_k 8192 of {u * s_w} x{NEIGHBOURS}: "
+        f"{timed(lambda: f_topk(grid))*1e3:.1f} ms"
+    )
+
+    # (2) full-grid [65k, 8] record scatter (the compaction-free
+    # alternative: every grid slot scatters, padding slots drop)
+    vals_grid8 = jnp.broadcast_to(
+        jnp.arange(u * s_w, dtype=jnp.uint32)[None, :, None],
+        (NEIGHBOURS, u * s_w, 8),
+    )
+    tblN8 = jnp.zeros((NEIGHBOURS, L * B, 8), jnp.uint32)
+
+    @jax.jit
+    def f_scatter_fullgrid(tbl, g, v):
+        def one(t, gi, vi):
+            return t.at[gi].set(vi, mode="drop")
+        return jax.vmap(one)(tbl, g, v)
+
+    log(
+        f"full-grid [{u * s_w},8] record scatter x{NEIGHBOURS}: "
+        f"{timed(lambda: f_scatter_fullgrid(tblN8, grid, vals_grid8))*1e3:.1f} ms"
+    )
+
+    # (3) aux-scatter fusion: amin min-scatter + amax max-scatter at the
+    # same (row, slot) indices, separate vs fused via the unsigned
+    # complement trick (max(x) == ~min(~x)) into one [L*R, 2] min-scatter
+    RR = RCAP
+    aux_idx = jnp.asarray(rng.integers(0, L * RR, (NEIGHBOURS, E), np.int64))
+    aux_vals = jnp.asarray(rng.integers(0, 1 << 32, (NEIGHBOURS, E), np.uint32))
+    amin_t = jnp.full((NEIGHBOURS, L * RR), 0xFFFFFFFF, jnp.uint32)
+    amax_t = jnp.zeros((NEIGHBOURS, L * RR), jnp.uint32)
+
+    @jax.jit
+    def f_aux_separate(mn, mx, ai, av):
+        def one(m, x, i, v):
+            return m.at[i].min(v, mode="drop"), x.at[i].max(v, mode="drop")
+        return jax.vmap(one)(mn, mx, ai, av)
+
+    log(
+        f"amin+amax separate scatters @ {E} x{NEIGHBOURS}: "
+        f"{timed(lambda: f_aux_separate(amin_t, amax_t, aux_idx, aux_vals))*1e3:.1f} ms"
+    )
+
+    # the fused timing must include the per-call stack/unstack the real
+    # fused kernel pays (merge_slice_packed_fused re-stacks the summary
+    # tables inside every merge), not just the scatter
+    @jax.jit
+    def f_aux_fused(mn, mx, ai, av):
+        def one(m, x, i, v):
+            t = jnp.stack([m, ~x], axis=-1)  # [L*R, 2]
+            t = t.at[i].min(jnp.stack([v, ~v], axis=-1), mode="drop")
+            return t[..., 0], ~t[..., 1]
+        return jax.vmap(one)(mn, mx, ai, av)
+
+    log(
+        f"amin+~amax fused stack+[E,2] min-scatter+unstack @ {E} x{NEIGHBOURS}: "
+        f"{timed(lambda: f_aux_fused(amin_t, amax_t, aux_idx, aux_vals))*1e3:.1f} ms"
+    )
+
 
 if __name__ == "__main__":
     main()
