@@ -145,6 +145,11 @@ def bench_tpu(seed=0, on_primary=None):
     _stage("delta stream generation…")
     lam = GROUP * DELTA / L
     bw = max(16 if SMOKE else 8, math.ceil(lam + 6 * math.sqrt(lam) + 2))
+    # probe override: the Poisson formula can land on a non-power-of-2
+    # slice lane width (e.g. 9 at BENCH_GROUP=32), which TPU tiling
+    # penalises — BENCH_BIN_WIDTH pins it to isolate grouping effects
+    # (the stream generator still raises honestly on slice overflow)
+    bw = int(os.environ.get("BENCH_BIN_WIDTH", "0")) or bw
     lam_end = N_KEYS / L + (WARMUP_CALLS + CALLS + 1) * GROUP * DELTA / L
     if lam_end + 6 * math.sqrt(lam_end) > BIN_CAP:
         log(

@@ -38,10 +38,19 @@ def _load(path):
 def main():
     log = sys.argv[1] if len(sys.argv) > 1 else "/tmp/tpu_matrix.log"
     out = []
+    # /tmp does not survive container restarts — fall back to the
+    # committed copy of the session's north-star line, but NEVER present
+    # it as this window's result: label it stale and keep the exit code
+    # reporting that THIS window produced no fresh north-star artifact
     ns = _load("/tmp/northstar.json")
+    ns_stale = False
+    if ns is None:
+        ns = _load(os.path.join(REPO, "benchmarks", "results", "northstar.tpu.json"))
+        ns_stale = ns is not None
     chip_success = False
     if ns is None:
-        out.append("north-star: NO ARTIFACT at /tmp/northstar.json")
+        out.append("north-star: NO ARTIFACT at /tmp/northstar.json "
+                   "or benchmarks/results/northstar.tpu.json")
     elif "error" in ns:
         # bench.py's failure artifacts (claim failure, interrupt, crash)
         # carry an "error" field and exit 0 by contract — never present
@@ -51,11 +60,14 @@ def main():
         ratio = ns.get("vs_baseline", 0)
         fallback = "cpu_fallback" in ns.get("metric", "")
         tag = "  (CPU FALLBACK — not a chip number)" if fallback else ""
+        if ns_stale:
+            tag += ("  (committed artifact from an EARLIER session — this "
+                    "window wrote no fresh north-star)")
         verdict = "MEETS" if ratio >= 10 else "below"
         out.append(f"north-star: {ns.get('value')} merges/sec, vs_baseline {ratio} — {verdict} the >=10x target{tag}")
         if ns.get("secondary_assert_failed"):
             out.append("  WARNING: GROUP=1 secondary tripped its overflow assertion")
-        chip_success = not fallback
+        chip_success = not fallback and not ns_stale
 
     if ns is not None and "error" not in ns:
         cols = ns.get("columns_merges_per_sec")
@@ -67,7 +79,16 @@ def main():
                 "is the headline value; promote ops/packed.py as the default "
                 "layout if packed wins on chip"
             )
-        else:
+        fus = ns.get("packed_fused_merges_per_sec")
+        unf = ns.get("packed_unfused_merges_per_sec")
+        if fus and unf:
+            out.append(
+                f"fusion A/B (same run): packed_unfused {unf} vs "
+                f"packed_fused {fus} merges/sec ({fus / unf:.2f}x) — promote "
+                "merge_slice_packed_fused to the bench default if the fused "
+                "kernel wins on chip"
+            )
+        if not (cols and pkd) and not (fus and unf):
             out.append("layout A/B: fields absent (BENCH_AB=0 or pre-A/B artifact)")
 
     rows = []
