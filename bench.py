@@ -148,8 +148,13 @@ def bench_tpu(seed=0, on_primary=None):
     # probe override: the Poisson formula can land on a non-power-of-2
     # slice lane width (e.g. 9 at BENCH_GROUP=32), which TPU tiling
     # penalises — BENCH_BIN_WIDTH pins it to isolate grouping effects
-    # (the stream generator still raises honestly on slice overflow)
-    bw = int(os.environ.get("BENCH_BIN_WIDTH", "0")) or bw
+    # (the stream generator still raises honestly on slice overflow; a
+    # malformed value must not crash a claimed chip window, so it falls
+    # back to the formula)
+    try:
+        bw = int(os.environ.get("BENCH_BIN_WIDTH", "0").strip() or 0) or bw
+    except ValueError:
+        log(f"ignoring malformed BENCH_BIN_WIDTH={os.environ['BENCH_BIN_WIDTH']!r}")
     lam_end = N_KEYS / L + (WARMUP_CALLS + CALLS + 1) * GROUP * DELTA / L
     if lam_end + 6 * math.sqrt(lam_end) > BIN_CAP:
         log(

@@ -70,11 +70,12 @@ def main():
         chip_success = not fallback and not ns_stale
 
     if ns is not None and "error" not in ns:
+        run_tag = "EARLIER session" if ns_stale else "same run"
         cols = ns.get("columns_merges_per_sec")
         pkd = ns.get("packed_merges_per_sec")
         if cols and pkd:
             out.append(
-                f"layout A/B (same run): columns {cols} vs packed {pkd} "
+                f"layout A/B ({run_tag}): columns {cols} vs packed {pkd} "
                 f"merges/sec ({pkd / cols:.2f}x) — winner '{ns.get('layout')}' "
                 "is the headline value; promote ops/packed.py as the default "
                 "layout if packed wins on chip"
@@ -83,7 +84,7 @@ def main():
         unf = ns.get("packed_unfused_merges_per_sec")
         if fus and unf:
             out.append(
-                f"fusion A/B (same run): packed_unfused {unf} vs "
+                f"fusion A/B ({run_tag}): packed_unfused {unf} vs "
                 f"packed_fused {fus} merges/sec ({fus / unf:.2f}x) — promote "
                 "merge_slice_packed_fused to the bench default if the fused "
                 "kernel wins on chip"
