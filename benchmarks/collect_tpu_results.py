@@ -100,9 +100,10 @@ def main():
         bench = data.get("bench", os.path.basename(path))
         cells = {
             k: v for k, v in data.items()
-            if k not in ("bench", "backend", "devices", "utc")
+            if k not in ("bench", "backend", "devices", "utc", "partial")
         }
-        rows.append(f"| {bench} ({data.get('utc', '?')}) | " +
+        tag = " (PARTIAL — killed mid-run)" if data.get("partial") else ""
+        rows.append(f"| {bench}{tag} ({data.get('utc', '?')}) | " +
                     ", ".join(f"{k}={v}" for k, v in cells.items()) + " |")
     if rows:
         out.append("\nTPU harness rows (paste into BASELINE.md):")

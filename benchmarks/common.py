@@ -71,7 +71,9 @@ class BenchRecorder:
 def emit(name: str, results: dict):
     """Log results AND persist them to ``benchmarks/results/<name>.<backend>.json``
     so measured numbers are committed alongside the harness (BASELINE.md's
-    measurement matrix)."""
+    measurement matrix). The write is atomic (temp file + ``os.replace``):
+    per-cell partial emits exist to survive watchdog kills, so a kill
+    landing mid-write must not truncate the evidence it protects."""
     import datetime
     import os
 
@@ -88,6 +90,58 @@ def emit(name: str, results: dict):
     log(json.dumps(payload, default=float))
     out_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
     os.makedirs(out_dir, exist_ok=True)
-    with open(os.path.join(out_dir, f"{name}.{backend}.json"), "w") as f:
+    path = os.path.join(out_dir, f"{name}.{backend}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(payload, f, indent=1, default=float)
         f.write("\n")
+    os.replace(tmp, path)
+
+
+def emit_partial(name: str, results: dict):
+    """Per-cell checkpoint of a multi-cell bench: same artifact, flagged
+    ``partial`` so the resume gate re-runs the row and the digest labels
+    it — a watchdog kill keeps the finished cells."""
+    emit(name, {**results, "partial": True})
+
+
+def load_partial(name: str, max_age_s: float = 43200) -> dict:
+    """Cells from a FRESH partial artifact of this bench on this
+    backend, so a re-run after a watchdog kill resumes where it died
+    instead of overwriting the richer evidence with its first cell.
+    Complete artifacts return {} (the caller is a deliberate fresh run),
+    as do stale ones (another session's cells must not mix in)."""
+    import datetime
+    import os
+
+    import jax
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "results",
+        f"{name}.{jax.default_backend()}.json",
+    )
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if not d.get("partial"):
+        return {}
+    try:
+        t = datetime.datetime.fromisoformat(d["utc"])
+        if t.tzinfo is None:
+            t = t.replace(tzinfo=datetime.timezone.utc)
+        age = (datetime.datetime.now(datetime.timezone.utc) - t).total_seconds()
+        if not (0 <= age < max_age_s):
+            return {}
+    except (KeyError, ValueError):
+        return {}
+    cells = {
+        k: v
+        for k, v in d.items()
+        if k not in ("bench", "backend", "devices", "utc", "partial")
+    }
+    if cells:
+        log(f"{name}: resuming from partial artifact with {len(cells)} cells")
+    return cells

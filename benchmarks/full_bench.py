@@ -17,7 +17,7 @@ import time
 from delta_crdt_ex_tpu import AWLWWMap
 from delta_crdt_ex_tpu.api import start_link
 from delta_crdt_ex_tpu.runtime.transport import LocalTransport
-from benchmarks.common import emit, log
+from benchmarks.common import emit, emit_partial, load_partial, log
 
 
 def do_test(number):
@@ -61,12 +61,20 @@ def do_test(number):
 
 
 def main(sizes=(10, 100, 1000, 10_000, 20_000, 30_000)):
-    results = {}
-    for n in sizes:
+    # resume a killed run's cells, and checkpoint after every size: a
+    # watchdog kill on a tunnel-slow backend keeps the finished cells
+    results = load_partial("full_bench")
+    todo = [
+        n for n in sizes
+        if not (f"add@{n}" in results and f"remove@{n}" in results)
+    ]
+    for i, n in enumerate(todo):
         t_add, t_remove = do_test(n)
         results[f"add@{n}"] = round(t_add, 3)
         results[f"remove@{n}"] = round(t_remove, 3)
         log(f"N={n}: add+converge {t_add:.3f}s, remove+converge {t_remove:.3f}s")
+        if i + 1 < len(todo):
+            emit_partial("full_bench", results)
     emit("full_bench", results)
     return results
 

@@ -25,7 +25,7 @@ import time
 from delta_crdt_ex_tpu import AWLWWMap
 from delta_crdt_ex_tpu.api import start_link
 from delta_crdt_ex_tpu.runtime.transport import LocalTransport
-from benchmarks.common import BenchRecorder, emit, log
+from benchmarks.common import BenchRecorder, emit, emit_partial, load_partial, log
 
 DEVICE_PLANE = os.environ.get("PROP_DEVICE_PLANE") == "1"
 
@@ -99,15 +99,27 @@ def perform(pair, op):
 def main(sizes=(20_000, 30_000)):
     results = {}
     tag = "@dev" if DEVICE_PLANE else ""
-    for n in sizes:
-        for op in ("add", "remove"):
-            log(f"preparing {n}-key pair for {op}{tag}…")
-            dt = perform(prepare(n), op)
-            results[f"{op}10@{n}{tag}"] = round(dt * 1000, 2)
-            log(f"{op} 10 into {n}-key pair{tag}: {dt*1000:.1f} ms")
     # separate results file per plane — emit() rewrites whole files, and
     # a device-plane run must not clobber the host-plane rows
-    emit("propagation_devplane" if DEVICE_PLANE else "propagation", results)
+    name = "propagation_devplane" if DEVICE_PLANE else "propagation"
+    # each cell converges tens of thousands of keys through the
+    # (possibly tunnel-slow) backend before its timed 10 ops — resume a
+    # killed run's finished cells and checkpoint after every cell
+    results.update(load_partial(name))
+    todo = [
+        (n, op)
+        for n in sizes
+        for op in ("add", "remove")
+        if f"{op}10@{n}{tag}" not in results
+    ]
+    for i, (n, op) in enumerate(todo):
+        log(f"preparing {n}-key pair for {op}{tag}…")
+        dt = perform(prepare(n), op)
+        results[f"{op}10@{n}{tag}"] = round(dt * 1000, 2)
+        log(f"{op} 10 into {n}-key pair{tag}: {dt*1000:.1f} ms")
+        if i + 1 < len(todo):
+            emit_partial(name, results)
+    emit(name, results)
     return results
 
 

@@ -21,11 +21,12 @@ run_row() { # name timeout module [env...]
   local art="benchmarks/results/${name}.tpu.json"
   if [ -f "$art" ] && python - "$art" <<'PY' 2>/dev/null
 import datetime as dt, json, sys
-t = dt.datetime.fromisoformat(json.load(open(sys.argv[1]))["utc"])
+d = json.load(open(sys.argv[1]))
+t = dt.datetime.fromisoformat(d["utc"])
 if t.tzinfo is None:
     t = t.replace(tzinfo=dt.timezone.utc)
 age = (dt.datetime.now(dt.timezone.utc) - t).total_seconds()
-sys.exit(0 if 0 <= age < 43200 else 1)
+sys.exit(0 if 0 <= age < 43200 and not d.get("partial") else 1)
 PY
   then
     say "$name: fresh artifact exists, skipping"
