@@ -59,9 +59,15 @@ PACKED = os.environ.get("BENCH_PACKED", "1") == "1"
 #: A/B switch for the fused-aux packed kernel (amin/amax/ctx as one
 #: [L,R,3] min-scatter via the unsigned-complement identity, fill/leaf
 #: as one [k,2] add-scatter — ~25% fewer random-access index entries).
-#: Pre-staged candidate: BENCH_FUSED=1 times it as primary and the A/B
-#: alternate becomes the plain packed kernel, so one chip run decides.
+#: Chip A/B 2026-07-31: LOST 1.9x (BASELINE.md) — kept as an opt-in
+#: probe; the A/B alternate is the plain packed kernel.
 FUSED = PACKED and os.environ.get("BENCH_FUSED", "0") == "1"
+#: A/B switch for top_k-free insert compaction (cumsum rank + one
+#: packed [G,9] compaction scatter instead of the per-neighbour top_k
+#: over the 65,536-slot grid). BENCH_SCOMP=1 times it as primary with
+#: the top_k packed kernel as the A/B alternate, so one chip run
+#: decides whether the top_k is the roofline gap's missing term.
+SCOMP = PACKED and not FUSED and os.environ.get("BENCH_SCOMP", "0") == "1"
 
 N_KEYS = 4096 if SMOKE else 1_000_000
 # geometry: load ≈ N_KEYS/L per bucket; bin capacity must clear the
@@ -183,18 +189,22 @@ def bench_tpu(seed=0, on_primary=None):
         from delta_crdt_ex_tpu.ops.packed import (
             merge_slice_packed,
             merge_slice_packed_fused,
+            merge_slice_packed_scomp,
             pack,
         )
 
         _stage("packing entry columns (BENCH_PACKED=1)…")
         stacked = jax.jit(pack)(stacked)
         jax.block_until_ready(stacked)
-        merge_fn = merge_slice_packed_fused if FUSED else merge_slice_packed
-        log(
-            "merge layout: packed, fused aux scatters"
-            if FUSED
-            else "merge layout: packed (one vector scatter per insert)"
-        )
+        if FUSED:
+            merge_fn = merge_slice_packed_fused
+            log("merge layout: packed, fused aux scatters")
+        elif SCOMP:
+            merge_fn = merge_slice_packed_scomp
+            log("merge layout: packed, top_k-free scatter compaction")
+        else:
+            merge_fn = merge_slice_packed
+            log("merge layout: packed (one vector scatter per insert)")
 
     merges = CALLS * GROUP * NEIGHBOURS
 
@@ -296,11 +306,17 @@ def bench_tpu(seed=0, on_primary=None):
     if not SMOKE and os.environ.get("BENCH_AB", "1") == "1":
         try:
             _stage("alternate-layout A/B…")
-            from delta_crdt_ex_tpu.ops.packed import merge_slice_packed, pack
+            from delta_crdt_ex_tpu.ops.packed import (  # noqa: F811
+                merge_slice_packed,
+                pack,
+            )
 
             if FUSED:
                 # fused primary → the A/B isolates the fusion itself
                 alt_name, alt_fn = "packed_unfused", merge_slice_packed
+            elif SCOMP:
+                # scomp primary → the A/B isolates the compaction change
+                alt_name, alt_fn = "packed_topk", merge_slice_packed
             elif PACKED:
                 alt_name, alt_fn = "columns", merge_slice
             else:
@@ -318,7 +334,10 @@ def bench_tpu(seed=0, on_primary=None):
             _st2, dt2 = timed_group_run(alt_fn, base)
             alt = (alt_name, merges / dt2)
             primary_name = (
-                "packed_fused" if FUSED else ("packed" if PACKED else "columns")
+                "packed_fused" if FUSED
+                else "packed_scomp" if SCOMP
+                else "packed" if PACKED
+                else "columns"
             )
             log(
                 f"A/B: {alt_name} {merges / dt2:.1f} vs "
@@ -724,7 +743,12 @@ def _main_measured(budget: Budget, fallback_reserve: float, run_state: dict):
             raise SystemExit("bench failed on accelerator AND cpu")
 
     value = float(res["merges_per_sec"])
-    layout = "packed_fused" if FUSED else ("packed" if PACKED else "columns")
+    layout = (
+        "packed_fused" if FUSED
+        else "packed_scomp" if SCOMP
+        else "packed" if PACKED
+        else "columns"
+    )
     line = {
         "metric": _metric_name(run_state["fallback"]),
         "unit": "merges/sec",

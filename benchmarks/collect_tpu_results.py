@@ -89,6 +89,25 @@ def main():
                 "merge_slice_packed_fused to the bench default if the fused "
                 "kernel wins on chip"
             )
+
+    # the scomp A/B writes its own artifact (resume_tpu_matrix.sh):
+    # top_k-free compaction vs the top_k packed kernel
+    sc = _load(os.path.join(REPO, "benchmarks", "results", "scomp_ab.json"))
+    if sc is not None and "error" not in sc:
+        scp = sc.get("packed_scomp_merges_per_sec")
+        tk = sc.get("packed_topk_merges_per_sec")
+        if scp and tk:
+            out.append(
+                f"scomp A/B: packed_topk {tk} vs packed_scomp {scp} "
+                f"merges/sec ({scp / tk:.2f}x) — promote "
+                "merge_slice_packed_scomp to the bench default if the "
+                "top_k-free compaction wins on chip"
+            )
+        elif sc.get("value"):
+            out.append(
+                f"scomp run: {sc.get('value')} merges/sec "
+                f"(layout {sc.get('layout')}, no in-run A/B fields)"
+            )
         if not (cols and pkd) and not (fus and unf):
             out.append("layout A/B: fields absent (BENCH_AB=0 or pre-A/B artifact)")
 

@@ -11,6 +11,9 @@ set -u
 cd "$(dirname "$0")/.."
 LOG="${1:-benchmarks/results/tpu_resume.log}"
 say() { echo "[tpu-resume $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
+# failure-shaped bench.py artifact lines carry an "error" field; plain
+# success lines never do (same contract as run_tpu_matrix.sh)
+ok_line() { case "$1" in ""|*'"error"'*) return 1;; *) return 0;; esac; }
 
 run_row() { # name timeout module [env...]
   local name="$1" tmo="$2" mod="$3"; shift 3
@@ -58,6 +61,25 @@ else
     say "profile_merge_parts done"; echo "merge-parts done" >>"$LOG"
   else
     say "profile_merge_parts FAILED (rc=$?)"
+  fi
+fi
+
+# top_k-free compaction A/B: the roofline gap's prime suspect is the
+# per-neighbour top_k; BENCH_SCOMP=1 times the cumsum+scatter variant
+# as primary with the top_k kernel as the in-run alternate (CPU smoke
+# already shows ~3x there — the chip decides the promotion)
+if grep -q "scomp A/B:" "$LOG" 2>/dev/null; then
+  say "scomp A/B: already captured, skipping"
+else
+  say "scomp A/B bench (top_k-free compaction vs top_k)"
+  BENCH_SCOMP=1 BENCH_TOTAL_BUDGET=2200 BENCH_CLAIM_TIMEOUT=120 \
+  BENCH_CLAIM_ATTEMPTS=2 BENCH_TPU_TIMEOUT=2000 BENCH_NO_CPU_FALLBACK=1 \
+    timeout 2400 python bench.py > benchmarks/results/scomp_ab.json 2>>"$LOG"
+  SCOMP_LINE=$(tail -1 benchmarks/results/scomp_ab.json 2>/dev/null)
+  if ok_line "$SCOMP_LINE"; then
+    say "scomp A/B: $SCOMP_LINE"
+  else
+    say "scomp A/B FAILED: $SCOMP_LINE"
   fi
 fi
 

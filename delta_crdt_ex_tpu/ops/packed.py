@@ -181,6 +181,23 @@ def merge_slice_packed_fused(
     )
 
 
+def merge_slice_packed_scomp(
+    state: PackedStore,
+    sl,
+    kill_budget: int,
+    max_inserts: int | None = None,
+) -> MergeResult:
+    """:func:`merge_slice_packed` with top_k-free insert compaction
+    (``scatter_compact=True``): the per-neighbour ``top_k`` over the
+    slice grid is replaced by a cumsum rank + one packed ``[G, 9]``
+    compaction scatter. Pre-staged A/B candidate (``BENCH_SCOMP=1``);
+    bit-identical to the top_k path on valid merges (trash-row contents
+    differ only where every consumer masks or drops them)."""
+    return merge_slice_packed(
+        state, sl, kill_budget, max_inserts, scatter_compact=True
+    )
+
+
 def compact_rows_packed(p: PackedStore) -> PackedStore:
     """:func:`~delta_crdt_ex_tpu.ops.binned.compact_rows` over the packed
     layout (unpack → dense repack → pack: compaction is a rare
@@ -195,6 +212,7 @@ def merge_slice_packed(
     kill_budget: int,
     max_inserts: int | None = None,
     fused_aux: bool = False,
+    scatter_compact: bool = False,
 ) -> MergeResult:
     """:func:`~delta_crdt_ex_tpu.ops.binned.merge_slice` over the packed
     layout: identical insert/kill/context math, but the 7 per-column
@@ -227,11 +245,64 @@ def merge_slice_packed(
     )
     n_inserted = jnp.sum(ins.astype(jnp.int32))
 
+    compacted = False
     if max_inserts is None:
         need_ins_tier = jnp.bool_(False)
         flat_c = flat.reshape(-1)
         sel = slice(None)
         sorted_hint = False
+    elif scatter_compact and L * B + u * s < 2**31:
+        # top_k-free compaction: the per-neighbour top_k over the [u·s]
+        # grid is O(G log G) sort work; a cumsum rank (streaming) plus
+        # ONE packed [G, 9]-plane scatter compacts the same entries in
+        # O(G) index entries. Row-major grid order = ascending flat
+        # index for real inserts, so the compacted indices stay sorted
+        # (same sorted_hint as the top_k path). The u32 flat plane
+        # limits this branch to L·B + G < 2^31 (every real geometry).
+        k = min(max_inserts, flat.size)
+        flat_flat = flat.reshape(-1)
+        ins_flat = flat_flat < L * B
+        rank = jnp.cumsum(ins_flat.astype(jnp.int32)) - 1
+        dest = jnp.where(ins_flat, rank, k)  # k = trash row; >k drops
+        planes = jnp.concatenate(
+            [
+                _b32(sl.key.reshape(-1)),  # [G, 2]
+                _b32(sl.ts.reshape(-1)),  # [G, 2]
+                sl.valh.reshape(-1)[:, None],
+                sl.ctr.reshape(-1)[:, None],
+                ln_clip.reshape(-1).astype(jnp.uint32)[:, None],
+                jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1)
+                .reshape(-1)
+                .astype(jnp.uint32)[:, None],
+                flat_flat.astype(jnp.uint32)[:, None],
+            ],
+            axis=-1,
+        )  # [G, 9]
+        # dest is NOT sorted (the trash index k interleaves among the
+        # ascending ranks wherever a non-insert precedes an insert), so
+        # no indices_are_sorted hint here — a false hint is UB in XLA.
+        # The LATER flat_c scatter keeps its hint: compacted flat values
+        # are ascending with unique ascending pad tails.
+        comp = (
+            jnp.zeros((k + 1, planes.shape[-1]), jnp.uint32)
+            .at[dest]
+            .set(planes, mode="drop")
+        )[:k]
+        kpos = jnp.arange(k, dtype=idx_dtype)
+        # `real` counts only in-bounds inserts (bin-overflowed entries
+        # carry pad flat values and never enter the compaction); the
+        # tier flag keeps the top_k path's conservative n_inserted
+        real = kpos < jnp.sum(ins_flat.astype(jnp.int32))
+        flat_c = jnp.where(real, comp[:, 8].astype(idx_dtype), L * B + kpos)
+        key_c = jax.lax.bitcast_convert_type(comp[:, 0:2], jnp.uint64)
+        ts_c = jax.lax.bitcast_convert_type(comp[:, 2:4], jnp.int64)
+        valh_c = comp[:, 4]
+        ctr_c = comp[:, 5]
+        ln_c = comp[:, 6].astype(jnp.int32)
+        node_c = comp[:, 7].astype(jnp.int32)
+        need_ins_tier = n_inserted > k
+        sorted_hint = True
+        compacted = True
     else:
         k = min(max_inserts, flat.size)
         neg_vals, sel = jax.lax.top_k(-flat.reshape(-1), k)
@@ -239,13 +310,14 @@ def merge_slice_packed(
         need_ins_tier = n_inserted > sel.shape[0]
         sorted_hint = True
 
-    take = lambda a: a.reshape(-1)[sel]
-    key_c = take(sl.key)
-    valh_c = take(sl.valh)
-    ts_c = take(sl.ts)
-    ctr_c = take(sl.ctr)
-    ln_c = take(ln_clip).astype(jnp.int32)
-    node_c = take(jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1))
+    if not compacted:
+        take = lambda a: a.reshape(-1)[sel]
+        key_c = take(sl.key)
+        valh_c = take(sl.valh)
+        ts_c = take(sl.ts)
+        ctr_c = take(sl.ctr)
+        ln_c = take(ln_clip).astype(jnp.int32)
+        node_c = take(jnp.clip(sl.node, 0, sl.ctx_gid.shape[0] - 1))
     eh_c = entry_hash(key_c, _table_lookup(sl.ctx_gid, node_c), ctr_c, ts_c, valh_c)
     ins_c = flat_c < L * B  # real inserts; padding indices scatter-drop
     rows_c = (flat_c // B).astype(jnp.int32)

@@ -193,6 +193,64 @@ def test_fused_aux_interval_stream_parity():
         assert_bitwise_equal(unpack(st_b), unpack(st_a), i)
 
 
+def test_scomp_parity_randomized():
+    """``merge_slice_packed_scomp`` (cumsum-rank + one packed compaction
+    scatter instead of the per-neighbour top_k) must be bit-identical to
+    the top_k packed kernel on every VALID merge; flags always agree."""
+    from delta_crdt_ex_tpu.ops.packed import merge_slice_packed_scomp
+
+    rng = np.random.default_rng(10)
+    for trial in range(10):
+        L = 16
+        a = BinnedKernelMap(gid=100, capacity=128, rcap=4, num_buckets=L)
+        b = BinnedKernelMap(gid=200, capacity=128, rcap=4, num_buckets=L)
+        for ts in range(1, int(rng.integers(2, 25))):
+            who = a if rng.random() < 0.5 else b
+            k = int(rng.integers(0, 24))
+            op = rng.random()
+            if op < 0.7:
+                who.add(k, int(rng.integers(0, 100)), ts=ts)
+            elif op < 0.95:
+                who.remove(k, ts=ts)
+            else:
+                who.clear(ts=ts)
+        if rng.random() < 0.6:
+            a.join_from(b)
+        sl = extract_rows(b.state, jnp.arange(L, dtype=jnp.int32))
+        st_pk = pack(a.state)
+        for max_inserts in (8, 256):  # 8 exercises the overflow flag
+            r1 = merge_slice_packed(st_pk, sl, kill_budget=L, max_inserts=max_inserts)
+            r2 = merge_slice_packed_scomp(
+                st_pk, sl, kill_budget=L, max_inserts=max_inserts
+            )
+            ctx = (trial, max_inserts)
+            for fl in ("ok", "need_gid_grow", "need_kill_tier",
+                       "need_fill_compact", "need_ctx_gap", "need_ins_tier"):
+                assert bool(getattr(r1, fl)) == bool(getattr(r2, fl)), (ctx, fl)
+            if bool(r1.ok):
+                assert_bitwise_equal(unpack(r2.state), unpack(r1.state), ctx)
+                assert int(r1.n_inserted) == int(r2.n_inserted), ctx
+                assert int(r1.n_killed) == int(r2.n_killed), ctx
+
+
+def test_scomp_interval_stream_parity():
+    from delta_crdt_ex_tpu.ops.packed import merge_slice_packed_scomp
+
+    rng = np.random.default_rng(11)
+    L = 64
+    keys = rng.integers(1, 1 << 63, size=2000, dtype=np.uint64)
+    st_col, _ = build_state(11, keys, num_buckets=L, bin_capacity=64)
+    st_a = pack(st_col)
+    st_b = st_a
+    slices, _ = interval_delta_stream(24, rng, 6, 64, L, bin_width=8)
+    for i, sl in enumerate(slices):
+        r1 = merge_slice_packed(st_a, sl, kill_budget=L, max_inserts=256)
+        r2 = merge_slice_packed_scomp(st_b, sl, kill_budget=L, max_inserts=256)
+        assert bool(r1.ok) and bool(r2.ok), i
+        st_a, st_b = r1.state, r2.state
+        assert_bitwise_equal(unpack(st_b), unpack(st_a), i)
+
+
 def test_packed_grow_and_compact_roundtrip():
     rng = np.random.default_rng(7)
     keys = rng.integers(1, 1 << 63, size=500, dtype=np.uint64)
