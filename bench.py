@@ -69,6 +69,16 @@ FUSED = PACKED and os.environ.get("BENCH_FUSED", "0") == "1"
 #: decides whether the top_k is the roofline gap's missing term.
 SCOMP = PACKED and not FUSED and os.environ.get("BENCH_SCOMP", "0") == "1"
 
+
+def layout_name() -> str:
+    """The primary merge layout's artifact label (one definition for the
+    child log line, the A/B log line, and the parent artifact field)."""
+    if FUSED:
+        return "packed_fused"
+    if SCOMP:
+        return "packed_scomp"
+    return "packed" if PACKED else "columns"
+
 N_KEYS = 4096 if SMOKE else 1_000_000
 # geometry: load ≈ N_KEYS/L per bucket; bin capacity must clear the
 # Poisson tail (≈ load + 6·sqrt(load)) — larger loads waste less headroom,
@@ -333,15 +343,9 @@ def bench_tpu(seed=0, on_primary=None):
             jax.block_until_ready(base)
             _st2, dt2 = timed_group_run(alt_fn, base)
             alt = (alt_name, merges / dt2)
-            primary_name = (
-                "packed_fused" if FUSED
-                else "packed_scomp" if SCOMP
-                else "packed" if PACKED
-                else "columns"
-            )
             log(
                 f"A/B: {alt_name} {merges / dt2:.1f} vs "
-                f"{primary_name} {merges / dt:.1f} merges/sec"
+                f"{layout_name()} {merges / dt:.1f} merges/sec"
             )
         except AssertionError as e:
             log(f"alternate-layout A/B overflowed a tier — ignored: {e!r}")
@@ -743,12 +747,7 @@ def _main_measured(budget: Budget, fallback_reserve: float, run_state: dict):
             raise SystemExit("bench failed on accelerator AND cpu")
 
     value = float(res["merges_per_sec"])
-    layout = (
-        "packed_fused" if FUSED
-        else "packed_scomp" if SCOMP
-        else "packed" if PACKED
-        else "columns"
-    )
+    layout = layout_name()
     line = {
         "metric": _metric_name(run_state["fallback"]),
         "unit": "merges/sec",

@@ -1,0 +1,46 @@
+"""Artifact freshness classification — dependency-free on purpose.
+
+The resume matrix's skip gate shells into this module per row; keeping
+it stdlib-only (no jax, no package imports) makes the gate instant and
+immune to backend-claim wedges. ``benchmarks.common`` re-uses the same
+predicate for ``load_partial`` so the two can't drift.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+
+
+def artifact_status(path: str, max_age_s: float = 43200, with_data: bool = False):
+    """Classify a results artifact: ``missing`` (absent/unreadable),
+    ``stale`` (emitted outside the freshness window), ``partial``
+    (fresh, mid-run checkpoint), or ``fresh`` (fresh and complete).
+    With ``with_data=True`` returns ``(status, dict | None)`` from ONE
+    read of the file, so callers never re-open it (the artifact can be
+    atomically replaced between reads by a concurrent run)."""
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, ValueError):
+        return ("missing", None) if with_data else "missing"
+
+    def done(status):
+        return (status, d) if with_data else status
+
+    try:
+        t = datetime.datetime.fromisoformat(d["utc"])
+        if t.tzinfo is None:
+            t = t.replace(tzinfo=datetime.timezone.utc)
+        age = (datetime.datetime.now(datetime.timezone.utc) - t).total_seconds()
+    except (KeyError, TypeError, ValueError):
+        return done("stale")
+    if not (0 <= age < max_age_s):
+        return done("stale")
+    return done("partial" if d.get("partial") else "fresh")
+
+
+if __name__ == "__main__":
+    import sys
+
+    print(artifact_status(sys.argv[1]))

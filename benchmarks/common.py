@@ -111,31 +111,20 @@ def load_partial(name: str, max_age_s: float = 43200) -> dict:
     instead of overwriting the richer evidence with its first cell.
     Complete artifacts return {} (the caller is a deliberate fresh run),
     as do stale ones (another session's cells must not mix in)."""
-    import datetime
     import os
 
     import jax
+
+    from benchmarks.artifact import artifact_status
 
     path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
         "results",
         f"{name}.{jax.default_backend()}.json",
     )
-    try:
-        with open(path) as f:
-            d = json.load(f)
-    except (OSError, ValueError):
-        return {}
-    if not d.get("partial"):
-        return {}
-    try:
-        t = datetime.datetime.fromisoformat(d["utc"])
-        if t.tzinfo is None:
-            t = t.replace(tzinfo=datetime.timezone.utc)
-        age = (datetime.datetime.now(datetime.timezone.utc) - t).total_seconds()
-        if not (0 <= age < max_age_s):
-            return {}
-    except (KeyError, ValueError):
+    # one read: the artifact can be atomically replaced under us
+    status, d = artifact_status(path, max_age_s, with_data=True)
+    if status != "partial":
         return {}
     cells = {
         k: v

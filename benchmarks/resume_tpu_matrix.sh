@@ -20,18 +20,15 @@ run_row() { # name timeout module [env...]
   # skip only artifacts FRESH within this round's window (12h), judged
   # by the emit() timestamp INSIDE the artifact (file mtimes reset on
   # git checkout): a committed artifact from an earlier session must not
-  # make a future session silently re-present old rows as newly measured
+  # make a future session silently re-present old rows as newly
+  # measured, and a mid-run partial checkpoint must be re-run (it seeds
+  # the re-run via load_partial). One shared predicate: common.py's
+  # artifact_status.
+  # benchmarks/artifact.py is dependency-free (no jax import — the
+  # ambient axon boot would block the gate on a wedged claim)
   local art="benchmarks/results/${name}.tpu.json"
-  if [ -f "$art" ] && python - "$art" <<'PY' 2>/dev/null
-import datetime as dt, json, sys
-d = json.load(open(sys.argv[1]))
-t = dt.datetime.fromisoformat(d["utc"])
-if t.tzinfo is None:
-    t = t.replace(tzinfo=dt.timezone.utc)
-age = (dt.datetime.now(dt.timezone.utc) - t).total_seconds()
-sys.exit(0 if 0 <= age < 43200 and not d.get("partial") else 1)
-PY
-  then
+  if [ -f "$art" ] && \
+     [ "$(timeout 60 python -m benchmarks.artifact "$art" 2>/dev/null)" = "fresh" ]; then
     say "$name: fresh artifact exists, skipping"
     return 0
   fi

@@ -19,6 +19,28 @@ def roundtrip_columns(st):
     return unpack(pack(st))
 
 
+def random_divergent_pair(rng, L=16, rcap=4):
+    """Two kernel maps with randomized interleaved add/remove/clear
+    histories (and a 60% chance the first has already observed the
+    second — giving kills remote targets) — the shared workload for the
+    kernel-variant parity suites."""
+    a = BinnedKernelMap(gid=100, capacity=128, rcap=rcap, num_buckets=L)
+    b = BinnedKernelMap(gid=200, capacity=128, rcap=rcap, num_buckets=L)
+    for ts in range(1, int(rng.integers(2, 25))):
+        who = a if rng.random() < 0.5 else b
+        k = int(rng.integers(0, 24))
+        op = rng.random()
+        if op < 0.7:
+            who.add(k, int(rng.integers(0, 100)), ts=ts)
+        elif op < 0.95:
+            who.remove(k, ts=ts)
+        else:
+            who.clear(ts=ts)
+    if rng.random() < 0.6:
+        a.join_from(b)
+    return a, b
+
+
 def assert_bitwise_equal(s1, s2, ctx):
     import dataclasses
 
@@ -38,20 +60,7 @@ def test_packed_merge_parity_randomized():
     rng = np.random.default_rng(4)
     for trial in range(10):
         L = 16
-        a = BinnedKernelMap(gid=100, capacity=128, rcap=4, num_buckets=L)
-        b = BinnedKernelMap(gid=200, capacity=128, rcap=4, num_buckets=L)
-        for ts in range(1, int(rng.integers(2, 25))):
-            who = a if rng.random() < 0.5 else b
-            k = int(rng.integers(0, 24))
-            op = rng.random()
-            if op < 0.7:
-                who.add(k, int(rng.integers(0, 100)), ts=ts)
-            elif op < 0.95:
-                who.remove(k, ts=ts)
-            else:
-                who.clear(ts=ts)
-        if rng.random() < 0.6:  # give kills remote targets
-            a.join_from(b)
+        a, b = random_divergent_pair(rng, L=L)
         sl = extract_rows(b.state, jnp.arange(L, dtype=jnp.int32))
         for max_inserts in (None, 256):
             r1 = merge_slice(a.state, sl, kill_budget=L, max_inserts=max_inserts)
@@ -144,20 +153,7 @@ def test_fused_aux_parity_randomized():
     rng = np.random.default_rng(8)
     for trial in range(10):
         L = 16
-        a = BinnedKernelMap(gid=100, capacity=128, rcap=4, num_buckets=L)
-        b = BinnedKernelMap(gid=200, capacity=128, rcap=4, num_buckets=L)
-        for ts in range(1, int(rng.integers(2, 25))):
-            who = a if rng.random() < 0.5 else b
-            k = int(rng.integers(0, 24))
-            op = rng.random()
-            if op < 0.7:
-                who.add(k, int(rng.integers(0, 100)), ts=ts)
-            elif op < 0.95:
-                who.remove(k, ts=ts)
-            else:
-                who.clear(ts=ts)
-        if rng.random() < 0.6:
-            a.join_from(b)
+        a, b = random_divergent_pair(rng, L=L)
         sl = extract_rows(b.state, jnp.arange(L, dtype=jnp.int32))
         st_pk = pack(a.state)
         for max_inserts in (None, 256):
@@ -202,20 +198,7 @@ def test_scomp_parity_randomized():
     rng = np.random.default_rng(10)
     for trial in range(10):
         L = 16
-        a = BinnedKernelMap(gid=100, capacity=128, rcap=4, num_buckets=L)
-        b = BinnedKernelMap(gid=200, capacity=128, rcap=4, num_buckets=L)
-        for ts in range(1, int(rng.integers(2, 25))):
-            who = a if rng.random() < 0.5 else b
-            k = int(rng.integers(0, 24))
-            op = rng.random()
-            if op < 0.7:
-                who.add(k, int(rng.integers(0, 100)), ts=ts)
-            elif op < 0.95:
-                who.remove(k, ts=ts)
-            else:
-                who.clear(ts=ts)
-        if rng.random() < 0.6:
-            a.join_from(b)
+        a, b = random_divergent_pair(rng, L=L)
         sl = extract_rows(b.state, jnp.arange(L, dtype=jnp.int32))
         st_pk = pack(a.state)
         for max_inserts in (8, 256):  # 8 exercises the overflow flag
