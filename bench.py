@@ -394,6 +394,21 @@ def _probed_roots_fn(num_leaves: int):
 # Python baseline (BEAM stand-in; see module docstring)
 
 def bench_python(seed=0):
+    """Best of 3 identical passes: single-pass timings on this shared
+    host vary ~1.7× with scheduler noise (observed 0.27–0.46 s for the
+    same work), and the baseline must be measured at its strongest —
+    the reported ratio should be conservative, not lucky. Each pass
+    rebuilds state from the same seed so merges never see a pre-warmed
+    context."""
+    best = None
+    for _ in range(3):
+        dt, merges = _bench_python_once(seed)
+        best = dt if best is None else min(best, dt)
+    log(f"python baseline: {merges} merges in {best:.3f}s (best of 3)")
+    return merges / best
+
+
+def _bench_python_once(seed):
     L, rng, keys = make_workload(seed)
 
     # state: key -> ((valh, ts), (writer, ctr)); per-bucket context and
@@ -443,9 +458,7 @@ def bench_python(seed=0):
     for entries in deltas:
         merge(entries)
     dt = time.perf_counter() - t0
-    merges = BASE_ITERS * GROUP
-    log(f"python baseline: {merges} merges in {dt:.3f}s")
-    return merges / dt
+    return dt, BASE_ITERS * GROUP
 
 
 class Budget:

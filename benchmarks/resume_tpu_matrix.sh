@@ -22,8 +22,8 @@ run_row() { # name timeout module [env...]
   # git checkout): a committed artifact from an earlier session must not
   # make a future session silently re-present old rows as newly
   # measured, and a mid-run partial checkpoint must be re-run (it seeds
-  # the re-run via load_partial). One shared predicate: common.py's
-  # artifact_status.
+  # the re-run via load_partial). One shared predicate:
+  # benchmarks/artifact.py's artifact_status (common.py imports it too).
   # benchmarks/artifact.py is dependency-free (no jax import — the
   # ambient axon boot would block the gate on a wedged claim)
   local art="benchmarks/results/${name}.tpu.json"
