@@ -89,6 +89,7 @@ jax.block_until_ready(out)
 print('entry ok:', jax.devices())
 " >>"$LOG" 2>&1 && say "entry compile OK" || say "entry compile FAILED"
 
+run_row ring_device 900 benchmarks.ring_device
 run_row ring_bench 1800 benchmarks.ring_bench
 run_row full_bench 2400 benchmarks.full_bench
 run_row mesh_gossip 1200 benchmarks.mesh_gossip
