@@ -7,6 +7,8 @@ overflow flags. Also pins ``pack``/``unpack`` as bitwise inverses.
 
 import numpy as np
 import jax.numpy as jnp
+from hypothesis import given, settings
+from hypothesis import strategies as hyp_st
 
 from delta_crdt_ex_tpu.ops.binned import extract_rows, merge_slice
 from delta_crdt_ex_tpu.ops.packed import merge_slice_packed, pack, unpack
@@ -19,26 +21,57 @@ def roundtrip_columns(st):
     return unpack(pack(st))
 
 
-def random_divergent_pair(rng, L=16, rcap=4):
-    """Two kernel maps with randomized interleaved add/remove/clear
-    histories (and a 60% chance the first has already observed the
-    second — giving kills remote targets) — the shared workload for the
-    kernel-variant parity suites."""
+def build_pair_from_ops(ops, pre_join, L=16, rcap=4):
+    """Two kernel maps built from an explicit interleaved history
+    (``ops`` = [(who, op, key, value), …]; ``pre_join`` makes the first
+    observe the second, giving kills remote targets) — ONE constructor
+    for both the seeded and the hypothesis parity suites."""
     a = BinnedKernelMap(gid=100, capacity=128, rcap=rcap, num_buckets=L)
     b = BinnedKernelMap(gid=200, capacity=128, rcap=rcap, num_buckets=L)
-    for ts in range(1, int(rng.integers(2, 25))):
-        who = a if rng.random() < 0.5 else b
+    for ts, (who, op, k, v) in enumerate(ops, start=1):
+        m = a if who == "a" else b
+        if op == "add":
+            m.add(k, v, ts=ts)
+        elif op == "remove":
+            m.remove(k, ts=ts)
+        else:
+            m.clear(ts=ts)
+    if pre_join:
+        a.join_from(b)
+    return a, b
+
+
+def random_divergent_pair(rng, L=16, rcap=4):
+    """Randomized history for the seeded trials (same rng consumption
+    order as the original inline loops, so seeds reproduce)."""
+    ops = []
+    for _ in range(1, int(rng.integers(2, 25))):
+        who = "a" if rng.random() < 0.5 else "b"
         k = int(rng.integers(0, 24))
         op = rng.random()
         if op < 0.7:
-            who.add(k, int(rng.integers(0, 100)), ts=ts)
+            ops.append((who, "add", k, int(rng.integers(0, 100))))
         elif op < 0.95:
-            who.remove(k, ts=ts)
+            ops.append((who, "remove", k, 0))
         else:
-            who.clear(ts=ts)
-    if rng.random() < 0.6:
-        a.join_from(b)
-    return a, b
+            ops.append((who, "clear", 0, 0))
+    return build_pair_from_ops(ops, rng.random() < 0.6, L=L, rcap=rcap)
+
+
+def assert_variant_parity(r_ref, r, ctx):
+    """Flags must always agree; state/counters must be bit-identical
+    whenever the reference merge is valid (overflowed merges are
+    discarded by the tier ladder, so their dead fields may differ)."""
+    for fl in ("ok", "need_gid_grow", "need_kill_tier",
+               "need_fill_compact", "need_ctx_gap", "need_ins_tier"):
+        assert bool(getattr(r_ref, fl)) == bool(getattr(r, fl)), (ctx, fl)
+    if bool(r_ref.ok):
+        from delta_crdt_ex_tpu.ops.packed import PackedStore
+
+        as_cols = lambda s: unpack(s) if isinstance(s, PackedStore) else s
+        assert_bitwise_equal(as_cols(r.state), as_cols(r_ref.state), ctx)
+        assert int(r.n_inserted) == int(r_ref.n_inserted), ctx
+        assert int(r.n_killed) == int(r_ref.n_killed), ctx
 
 
 def assert_bitwise_equal(s1, s2, ctx):
@@ -161,14 +194,7 @@ def test_fused_aux_parity_randomized():
             r2 = merge_slice_packed_fused(
                 st_pk, sl, kill_budget=L, max_inserts=max_inserts
             )
-            ctx = (trial, max_inserts)
-            for fl in ("ok", "need_gid_grow", "need_kill_tier",
-                       "need_fill_compact", "need_ctx_gap", "need_ins_tier"):
-                assert bool(getattr(r1, fl)) == bool(getattr(r2, fl)), (ctx, fl)
-            if bool(r1.ok):
-                assert_bitwise_equal(unpack(r2.state), unpack(r1.state), ctx)
-                assert int(r1.n_inserted) == int(r2.n_inserted), ctx
-                assert int(r1.n_killed) == int(r2.n_killed), ctx
+            assert_variant_parity(r1, r2, (trial, max_inserts))
 
 
 def test_fused_aux_interval_stream_parity():
@@ -206,14 +232,7 @@ def test_scomp_parity_randomized():
             r2 = merge_slice_packed_scomp(
                 st_pk, sl, kill_budget=L, max_inserts=max_inserts
             )
-            ctx = (trial, max_inserts)
-            for fl in ("ok", "need_gid_grow", "need_kill_tier",
-                       "need_fill_compact", "need_ctx_gap", "need_ins_tier"):
-                assert bool(getattr(r1, fl)) == bool(getattr(r2, fl)), (ctx, fl)
-            if bool(r1.ok):
-                assert_bitwise_equal(unpack(r2.state), unpack(r1.state), ctx)
-                assert int(r1.n_inserted) == int(r2.n_inserted), ctx
-                assert int(r1.n_killed) == int(r2.n_killed), ctx
+            assert_variant_parity(r1, r2, (trial, max_inserts))
 
 
 def test_scomp_interval_stream_parity():
@@ -232,6 +251,43 @@ def test_scomp_interval_stream_parity():
         assert bool(r1.ok) and bool(r2.ok), i
         st_a, st_b = r1.state, r2.state
         assert_bitwise_equal(unpack(st_b), unpack(st_a), i)
+
+
+pair_ops = hyp_st.lists(
+    hyp_st.tuples(
+        hyp_st.sampled_from(["a", "b"]),  # who mutates
+        hyp_st.sampled_from(["add", "remove", "clear"]),
+        hyp_st.integers(min_value=0, max_value=23),  # key
+        hyp_st.integers(min_value=0, max_value=100),  # value
+    ),
+    max_size=30,
+)
+
+
+@settings(max_examples=25, deadline=None)
+@given(pair_ops, hyp_st.booleans(), hyp_st.sampled_from([8, 64]))
+def test_property_all_kernel_variants_agree(ops, pre_join, max_inserts):
+    """Hypothesis twin of the seeded parity trials: for ANY interleaved
+    history, the column kernel and every packed variant (plain, fused,
+    scomp) agree on flags, and bit-identically on state whenever the
+    merge is valid."""
+    from delta_crdt_ex_tpu.ops.packed import (
+        merge_slice_packed_fused,
+        merge_slice_packed_scomp,
+    )
+
+    L = 16
+    a, b = build_pair_from_ops(ops, pre_join, L=L)
+    sl = extract_rows(b.state, jnp.arange(L, dtype=jnp.int32))
+    r_col = merge_slice(a.state, sl, kill_budget=L, max_inserts=max_inserts)
+    st_pk = pack(a.state)
+    for name, fn in (
+        ("packed", merge_slice_packed),
+        ("fused", merge_slice_packed_fused),
+        ("scomp", merge_slice_packed_scomp),
+    ):
+        r = fn(st_pk, sl, kill_budget=L, max_inserts=max_inserts)
+        assert_variant_parity(r_col, r, name)
 
 
 def test_packed_grow_and_compact_roundtrip():
