@@ -73,19 +73,25 @@ def fanout_merge(
     )
 
 
-@partial(jax.jit, static_argnames=("kill_budget", "max_inserts"))
+@partial(jax.jit, static_argnames=("kill_budget", "max_inserts", "scatter_compact"))
 def fanout_merge_packed(
     stacked: PackedStore,
     sl: RowSlice,
     kill_budget: int = 64,
     max_inserts: int | None = None,
+    scatter_compact: bool = False,
 ) -> MergeResult:
     """:func:`fanout_merge` over the packed entry layout — the chip-
     measured fast path (north-star A/B on TPU v5e: packed 8,852.8 vs
     columns 4,211.9 merges/s; BASELINE.md "Merge-kernel roofline"). Same
     per-neighbour remap + interval-join semantics, one ``[k, 8]`` vector
-    scatter per neighbour instead of 7 scalar-column scatters."""
-    return jax.vmap(merge_slice_packed, in_axes=(0, None, None, None))(
+    scatter per neighbour instead of 7 scalar-column scatters.
+    ``scatter_compact=True`` additionally replaces the per-neighbour
+    top_k insert compaction with the cumsum+scatter form (the armed
+    ``BENCH_SCOMP`` candidate — parity-pinned; default flips if its
+    chip A/B wins)."""
+    fn = partial(merge_slice_packed, scatter_compact=scatter_compact)
+    return jax.vmap(fn, in_axes=(0, None, None, None))(
         stacked, sl, kill_budget, max_inserts
     )
 
@@ -100,6 +106,7 @@ def fanout_merge_into(
     kill_budget: int = 16,
     on_grow=None,
     n_alive: int | None = None,
+    scatter_compact: bool = False,
 ):
     """The vmapped analog of ``merge_into``: merge one slice into N
     stacked neighbour states, escalating tiers via the shared
@@ -111,15 +118,27 @@ def fanout_merge_into(
     Accepts either layout: pass a :class:`PackedStore` stack (see
     :func:`pack_states`) to run the chip-measured fast path; growth and
     compaction escalate through the same tier policy on both.
+    ``scatter_compact`` selects the top_k-free insert compaction and is
+    packed-only (the column kernel has no such variant) — raising on a
+    column stack keeps an A/B from silently timing the wrong kernel.
 
     Returns ``(stacked, last_result, n_retries)``."""
     if n_alive is None:
         n_alive = int(np.asarray(sl.alive).sum())
     packed = isinstance(stacked, PackedStore)
+    if scatter_compact and not packed:
+        raise TypeError(
+            "scatter_compact=True requires a PackedStore stack "
+            "(pack_states); the column kernel has no scomp variant"
+        )
+    if packed:
+        merge = partial(fanout_merge_packed, scatter_compact=scatter_compact)
+    else:
+        merge = fanout_merge
     return tier_retry_merge(
         stacked,
         sl,
-        fanout_merge_packed if packed else fanout_merge,
+        merge,
         jit_fanout_compact_packed if packed else jit_fanout_compact,
         kill_budget,
         pow2_tier(max(n_alive, 1)),

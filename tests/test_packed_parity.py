@@ -101,11 +101,12 @@ def test_packed_merge_parity_randomized():
                 pack(a.state), sl, kill_budget=L, max_inserts=max_inserts
             )
             ctx = (trial, max_inserts)
-            assert bool(r1.ok) == bool(r2.ok), ctx
+            assert_variant_parity(r1, r2, ctx)
+            # packed-vs-columns is bit-identical even on overflowed
+            # merges (both use the same top_k fill handling) — pin the
+            # stronger contract for this pair, plus semantic reads
             assert_bitwise_equal(unpack(r2.state), r1.state, ctx)
             assert_states_equal(unpack(r2.state), r1.state, ctx)
-            assert int(r1.n_inserted) == int(r2.n_inserted), ctx
-            assert int(r1.n_killed) == int(r2.n_killed), ctx
 
 
 def test_packed_interval_stream_parity():
@@ -118,12 +119,9 @@ def test_packed_interval_stream_parity():
     for i, sl in enumerate(slices):
         r1 = merge_slice(st_col, sl, kill_budget=L, max_inserts=256)
         r2 = merge_slice_packed(st_pk, sl, kill_budget=L, max_inserts=256)
-        assert bool(r1.ok) and bool(r2.ok), i
+        assert bool(r1.ok), i
+        assert_variant_parity(r1, r2, i)
         st_col, st_pk = r1.state, r2.state
-        assert_bitwise_equal(unpack(st_pk), st_col, i)
-        for fl in ("need_gid_grow", "need_kill_tier", "need_fill_compact",
-                   "need_ctx_gap", "need_ins_tier"):
-            assert bool(getattr(r1, fl)) == bool(getattr(r2, fl)), (i, fl)
 
 
 def test_packed_fanout_parity_with_growth():
@@ -163,16 +161,18 @@ def test_packed_fanout_parity_with_growth():
     sl = _extract(updater.state, jnp.arange(L, dtype=jnp.int32))
 
     col2, col_res, col_retries = fanout_merge_into(stacked, sl, kill_budget=2)
-    pk2, pk_res, pk_retries = fanout_merge_into(
-        pack_states(stacked), sl, kill_budget=2
-    )
-    assert bool(col_res.ok.all()) and bool(pk_res.ok.all())
-    assert col_retries == pk_retries and col_retries >= 1
-    assert pk2.bin_capacity == col2.bin_capacity >= 8
-    assert pk2.replica_capacity == col2.replica_capacity >= 4
-    assert_bitwise_equal(unpack(pk2), col2, "fanout growth")
-    for col_st, pk_st in zip(unstack_states(col2), unstack_states(unpack(pk2))):
-        assert_states_equal(pk_st, col_st, "per-neighbour")
+    assert bool(col_res.ok.all()) and col_retries >= 1
+    for scomp in (False, True):  # both packed compaction modes walk the ladder
+        pk2, pk_res, pk_retries = fanout_merge_into(
+            pack_states(stacked), sl, kill_budget=2, scatter_compact=scomp
+        )
+        assert bool(pk_res.ok.all()), scomp
+        assert col_retries == pk_retries, scomp
+        assert pk2.bin_capacity == col2.bin_capacity >= 8
+        assert pk2.replica_capacity == col2.replica_capacity >= 4
+        assert_bitwise_equal(unpack(pk2), col2, ("fanout growth", scomp))
+        for col_st, pk_st in zip(unstack_states(col2), unstack_states(unpack(pk2))):
+            assert_states_equal(pk_st, col_st, ("per-neighbour", scomp))
 
 
 def test_fused_aux_parity_randomized():
