@@ -55,7 +55,18 @@ say "merge-part probes (scatter/gather packing attribution)"
 timeout 1800 python -m benchmarks.profile_merge_parts >>"$LOG" 2>&1 \
   && say "profile_merge_parts done" || say "profile_merge_parts FAILED"
 
+# top_k-free compaction A/B (armed round 4; CPU full config ~1.9x)
+say "scomp A/B bench (top_k-free compaction vs top_k)"
+BENCH_SCOMP=1 BENCH_TOTAL_BUDGET=2200 BENCH_CLAIM_TIMEOUT=120 \
+BENCH_CLAIM_ATTEMPTS=2 BENCH_TPU_TIMEOUT=2000 BENCH_NO_CPU_FALLBACK=1 \
+  timeout 2400 python bench.py > benchmarks/results/scomp_ab.json 2>>"$LOG"
+SCOMP_LINE=$(tail -1 benchmarks/results/scomp_ab.json 2>/dev/null)
+ok_line "$SCOMP_LINE" && say "scomp A/B: $SCOMP_LINE" \
+  || say "scomp A/B FAILED: $SCOMP_LINE"
+
 say "harness matrix on TPU (runtime-driven; dispatch-bound, numbers are honest)"
+timeout 900 python -m benchmarks.ring_device >>"$LOG" 2>&1 \
+  && say "ring_device done" || say "ring_device FAILED"
 timeout 1800 python -m benchmarks.basic_operations >>"$LOG" 2>&1 \
   && say "basic_operations done" || say "basic_operations FAILED"
 timeout 1800 python -m benchmarks.propagation >>"$LOG" 2>&1 \
