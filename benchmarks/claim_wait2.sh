@@ -1,0 +1,28 @@
+#!/bin/bash
+# Follow-on claim waiter with an end-of-round deadline: probes until
+# DEADLINE_UTC (HH:MM, default 15:00) and fires the resume matrix on
+# recovery. The deadline keeps a late recovery from starting a ~1-2h
+# matrix that would still be holding the claim when the round driver
+# runs its own bench.
+set -u
+cd "$(dirname "$0")/.."
+LOG="${1:-benchmarks/results/claim_wait.log}"
+DEADLINE="${DEADLINE_UTC:-15:00}"
+say() { echo "[claim-wait2 $(date -u +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+while true; do
+  now=$(date -u +%H:%M)
+  if [ "$(printf '%s\n' "$now" "$DEADLINE" | sort | tail -1)" = "$now" ] \
+     && [ "$now" != "$DEADLINE" ]; then
+    say "deadline $DEADLINE UTC reached with the claim still wedged — stopping"
+    exit 1
+  fi
+  if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    say "claim recovered — firing resume matrix"
+    bash benchmarks/resume_tpu_matrix.sh benchmarks/results/tpu_resume.log
+    say "resume matrix finished"
+    exit 0
+  fi
+  say "claim still wedged — sleeping 120s"
+  sleep 120
+done
