@@ -1,0 +1,27 @@
+#!/bin/bash
+# Round-5 claim waiter with an EPOCH deadline (claim_wait2.sh compared
+# HH:MM strings, which breaks when the window crosses midnight UTC).
+# Probes until DEADLINE_EPOCH (unix seconds) and fires the resume
+# matrix on recovery. Leaves enough margin that a ~1-2h matrix is done
+# before the round driver runs its own bench.
+set -u
+cd "$(dirname "$0")/.."
+LOG="${1:-benchmarks/results/claim_wait.log}"
+DEADLINE="${DEADLINE_EPOCH:?set DEADLINE_EPOCH (unix seconds)}"
+say() { echo "[claim-wait3 $(date -u +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+say "starting; deadline $(date -u -d "@$DEADLINE" +%Y-%m-%dT%H:%M:%SZ)"
+while true; do
+  if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+    say "deadline reached with the claim still down — stopping"
+    exit 1
+  fi
+  if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    say "claim recovered — firing resume matrix"
+    bash benchmarks/resume_tpu_matrix.sh benchmarks/results/tpu_resume.log
+    say "resume matrix finished"
+    exit 0
+  fi
+  say "claim still down — sleeping 120s"
+  sleep 120
+done

@@ -69,6 +69,10 @@ def main():
             out.append("  WARNING: GROUP=1 secondary tripped its overflow assertion")
         chip_success = not fallback and not ns_stale
 
+    # initialised before the guarded block: the scomp section below
+    # reads these even when the north-star artifact is absent/errored
+    # (the resume-matrix scenario that only runs the scomp A/B)
+    cols = pkd = fus = unf = None
     if ns is not None and "error" not in ns:
         run_tag = "EARLIER session" if ns_stale else "same run"
         cols = ns.get("columns_merges_per_sec")
@@ -108,8 +112,8 @@ def main():
                 f"scomp run: {sc.get('value')} merges/sec "
                 f"(layout {sc.get('layout')}, no in-run A/B fields)"
             )
-        if not (cols and pkd) and not (fus and unf):
-            out.append("layout A/B: fields absent (BENCH_AB=0 or pre-A/B artifact)")
+    if ns is not None and "error" not in ns and not (cols and pkd) and not (fus and unf):
+        out.append("layout A/B: fields absent (BENCH_AB=0 or pre-A/B artifact)")
 
     rows = []
     for path in sorted(glob.glob(os.path.join(REPO, "benchmarks", "results", "*.tpu.json"))):

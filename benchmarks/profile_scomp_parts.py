@@ -1,0 +1,159 @@
+"""Phase-level attribution of the promoted scomp merge at the bench
+config — what eats the ~0.5 s/call left on CPU (and the ~113 ms/call
+left on chip) now that top_k is gone.
+
+Times (a) the full bench merge_chunk (merge + flags + roots), (b) the
+merge alone, (c) the digest-tree roots alone, then isolated synthetic
+probes for the scomp-specific terms: the per-neighbour [G,9] compaction
+scatter over the padded grid, the grid cumsum, and the main [k,8]
+record scatter. G = u·s is ~8x the real entry count at the bench shape
+(8,192 keys spread over ~6.4k buckets padded to 8,192 rows x 8 lanes),
+so the compaction term pays that padding tax per neighbour per call.
+
+Run: JAX_PLATFORMS=cpu python -m benchmarks.profile_scomp_parts
+(SCOMP_PARTS_NEIGHBOURS=16 shrinks the fan-in; numbers scale linearly.)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from delta_crdt_ex_tpu.utils.devices import enable_compilation_cache
+
+enable_compilation_cache()
+
+from delta_crdt_ex_tpu.ops.binned import tree_from_leaves
+from delta_crdt_ex_tpu.ops.packed import merge_slice_packed_scomp, pack
+from delta_crdt_ex_tpu.utils.synth import build_state, interval_delta_stream
+
+from benchmarks.common import log
+
+N_KEYS = 1_000_000
+TREE_DEPTH = 14
+BIN_CAP = 128
+NEIGHBOURS = int(os.environ.get("SCOMP_PARTS_NEIGHBOURS", "64"))
+DELTA = 512
+GROUP = 16
+RCAP = 8
+
+
+def timed(fn, n=6):
+    out = fn()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    L = 1 << TREE_DEPTH
+    B = BIN_CAP
+    rng = np.random.default_rng(0)
+    keys = rng.integers(1, 1 << 63, size=N_KEYS, dtype=np.uint64)
+    log(f"devices: {jax.devices()}")
+
+    one, _ = build_state(11, keys, num_buckets=L, bin_capacity=BIN_CAP,
+                         replica_capacity=RCAP)
+    one = jax.jit(pack)(one)
+    jax.block_until_ready(one)
+    stacked = jax.tree_util.tree_map(
+        lambda x: jnp.copy(jnp.broadcast_to(x, (NEIGHBOURS,) + x.shape)), one
+    )
+    jax.block_until_ready(stacked)
+
+    slices, _ = interval_delta_stream(22, rng, 1, GROUP * DELTA, L, bin_width=8)
+    sl = slices[0]
+    u, s_w = sl.key.shape
+    G = u * s_w
+    k = GROUP * DELTA
+    log(f"slice: rows={u} lanes={s_w} grid={G} inserts<={k}")
+
+    mfn = lambda st, s: merge_slice_packed_scomp(st, s, 8, k, rows_sorted=True)
+
+    @jax.jit
+    def f_full(states, s):
+        res = jax.vmap(mfn, in_axes=(0, None))(states, s)
+        roots = jax.vmap(lambda lf: tree_from_leaves(lf)[0][0])(res.state.leaf)
+        return res.ok, roots
+
+    log(f"merge+roots x{NEIGHBOURS}: {timed(lambda: f_full(stacked, sl))*1e3:.1f} ms")
+
+    @jax.jit
+    def f_merge(states, s):
+        res = jax.vmap(mfn, in_axes=(0, None))(states, s)
+        return res.ok, res.state.leaf
+
+    log(f"merge only  x{NEIGHBOURS}: {timed(lambda: f_merge(stacked, sl))*1e3:.1f} ms")
+
+    @jax.jit
+    def f_roots(states):
+        return jax.vmap(lambda lf: tree_from_leaves(lf)[0][0])(states.leaf)
+
+    log(f"roots only  x{NEIGHBOURS}: {timed(lambda: f_roots(stacked))*1e3:.1f} ms")
+
+    # --- isolated synthetic probes (shapes match the real kernel) -------
+    flatN = jnp.asarray(
+        rng.integers(0, L * B, (NEIGHBOURS, G), np.int64)
+    )
+    planesN = jnp.asarray(rng.integers(0, 1 << 32, (NEIGHBOURS, G, 9), np.uint32))
+
+    @jax.jit
+    def f_compact_scatter(fl, pl):
+        def one(f, p):
+            ins_flat = f < (L * B) // 2
+            rank = jnp.cumsum(ins_flat.astype(jnp.int32)) - 1
+            dest = jnp.where(ins_flat, rank, k)
+            return (
+                jnp.zeros((k + 1, 9), jnp.uint32).at[dest].set(p, mode="drop")
+            )[:k]
+        return jax.vmap(one)(fl, pl)
+
+    log(
+        f"[G={G},9] cumsum+compaction scatter x{NEIGHBOURS}: "
+        f"{timed(lambda: f_compact_scatter(flatN, planesN))*1e3:.1f} ms"
+    )
+
+    @jax.jit
+    def f_cumsum(fl):
+        return jax.vmap(lambda f: jnp.cumsum((f < (L * B) // 2).astype(jnp.int32)))(fl)
+
+    log(f"[G] cumsum x{NEIGHBOURS}: {timed(lambda: f_cumsum(flatN))*1e3:.1f} ms")
+
+    # the planes concatenate alone (9 [G]-plane writes per neighbour)
+    @jax.jit
+    def f_planes(pl):
+        return jax.vmap(lambda p: jnp.concatenate([p[:, i:i+1] for i in range(9)], axis=-1))(pl)
+
+    log(f"[G,9] plane concat x{NEIGHBOURS}: {timed(lambda: f_planes(planesN))*1e3:.1f} ms")
+
+    idxk = jnp.asarray(
+        np.sort(rng.choice(L * B, size=(NEIGHBOURS, k), replace=True), axis=1).astype(np.int64)
+    )
+    vals8 = jnp.asarray(rng.integers(0, 1 << 32, (NEIGHBOURS, k, 8), np.uint32))
+    tblN = jnp.zeros((NEIGHBOURS, L * B, 8), jnp.uint32)
+
+    @jax.jit
+    def f_main_scatter(tb, ix, v):
+        def one(t, i, vv):
+            return t.at[i].set(vv, mode="drop", indices_are_sorted=True)
+        return jax.vmap(one)(tb, ix, v)
+
+    log(
+        f"main [k={k},8] record scatter x{NEIGHBOURS}: "
+        f"{timed(lambda: f_main_scatter(tblN, idxk, vals8))*1e3:.1f} ms"
+    )
+
+
+if __name__ == "__main__":
+    main()

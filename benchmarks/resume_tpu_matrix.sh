@@ -44,6 +44,27 @@ say "resume session start; devices probe:"
 timeout 120 python -c "import jax; print(jax.devices())" >>"$LOG" 2>&1 \
   || { say "chip unreachable, aborting"; exit 1; }
 
+# Pallas verdict first — cheapest high-information probe in the window.
+# batched_roots_fn now logs the Mosaic failure reason instead of
+# swallowing it (r4 weak #3): either this prints "digest tree: pallas"
+# or the epitaph text BASELINE.md needs.
+if grep -q "pallas-verdict done" "$LOG" 2>/dev/null; then
+  say "pallas verdict: already captured, skipping"
+else
+  say "pallas verdict probe (batched_roots_fn on the live chip)"
+  if timeout 600 python -c "
+from delta_crdt_ex_tpu.utils.devices import enable_compilation_cache
+enable_compilation_cache()
+from delta_crdt_ex_tpu.ops.pallas_tree import batched_roots_fn
+fn, tag = batched_roots_fn(16384)
+print('digest tree:', tag)
+" >>"$LOG" 2>&1; then
+    say "pallas verdict done"; echo "pallas-verdict done" >>"$LOG"
+  else
+    say "pallas verdict probe FAILED (rc=$?)"
+  fi
+fi
+
 run_row basic_operations 1800 benchmarks.basic_operations
 
 # the attribution probes come BEFORE the slow runtime-driven rows: they
@@ -61,22 +82,40 @@ else
   fi
 fi
 
-# top_k-free compaction A/B: the roofline gap's prime suspect is the
-# per-neighbour top_k; BENCH_SCOMP=1 times the cumsum+scatter variant
-# as primary with the top_k kernel as the in-run alternate (CPU smoke
-# already shows ~3x there — the chip decides the promotion)
+# north-star with the PROMOTED scomp primary and top_k as the in-run
+# alternate (BENCH_SCOMP defaults on since round 5): one run decides
+# whether the promotion holds on chip AND refreshes the north-star —
+# a success is copied to northstar.tpu.json so the digest and BASELINE
+# see it as this window's headline.
 if grep -q "scomp A/B:" "$LOG" 2>/dev/null; then
   say "scomp A/B: already captured, skipping"
 else
-  say "scomp A/B bench (top_k-free compaction vs top_k)"
+  say "scomp north-star + A/B bench (promoted scomp vs top_k)"
   BENCH_SCOMP=1 BENCH_TOTAL_BUDGET=2200 BENCH_CLAIM_TIMEOUT=120 \
   BENCH_CLAIM_ATTEMPTS=2 BENCH_TPU_TIMEOUT=2000 BENCH_NO_CPU_FALLBACK=1 \
     timeout 2400 python bench.py > benchmarks/results/scomp_ab.json 2>>"$LOG"
   SCOMP_LINE=$(tail -1 benchmarks/results/scomp_ab.json 2>/dev/null)
   if ok_line "$SCOMP_LINE"; then
     say "scomp A/B: $SCOMP_LINE"
+    cp benchmarks/results/scomp_ab.json benchmarks/results/northstar.tpu.json
+    cp benchmarks/results/scomp_ab.json /tmp/northstar.json 2>/dev/null || true
+    say "north-star artifact refreshed from the scomp run"
   else
     say "scomp A/B FAILED: $SCOMP_LINE"
+  fi
+fi
+
+# attribution of the promoted kernel's remaining per-call cost (the
+# [G,9] compaction scatter is the CPU-side suspect; chip numbers decide
+# the next lever — see benchmarks/profile_scomp_parts.py)
+if grep -q "scomp-parts done" "$LOG" 2>/dev/null; then
+  say "profile_scomp_parts: already done, skipping"
+else
+  say "profile_scomp_parts: running at N=16 (timeout 900s)"
+  if SCOMP_PARTS_NEIGHBOURS=16 timeout 900 python -m benchmarks.profile_scomp_parts >>"$LOG" 2>&1; then
+    say "profile_scomp_parts done"; echo "scomp-parts done" >>"$LOG"
+  else
+    say "profile_scomp_parts FAILED (rc=$?)"
   fi
 fi
 

@@ -104,12 +104,26 @@ def batched_roots_fn(num_leaves: int):
 
     from delta_crdt_ex_tpu.ops.binned import tree_from_leaves as xla_tree
 
+    tag = "xla"
     if num_leaves >= 128:
         try:
             jax.jit(batched_roots_pallas)(
                 jnp.zeros((2, num_leaves), jnp.uint32)
             ).block_until_ready()
             return batched_roots_pallas, "pallas"
-        except Exception:
-            pass
-    return jax.vmap(lambda lf: xla_tree(lf)[0][0]), "xla"
+        except Exception as e:
+            # the probe's whole job on a new backend is to learn WHY the
+            # kernel won't lower — swallowing the Mosaic error here cost
+            # round 4 its chip verdict (every session just logged
+            # "digest tree: xla"); keep the fallback but surface the
+            # reason in the impl tag callers log
+            import sys
+
+            msg = " ".join(str(e).split())
+            print(
+                f"[pallas_tree] batched_roots_pallas probe failed: {msg[:500]}",
+                file=sys.stderr,
+                flush=True,
+            )
+            tag = f"xla (pallas probe failed: {type(e).__name__}: {msg[:160]})"
+    return jax.vmap(lambda lf: xla_tree(lf)[0][0]), tag

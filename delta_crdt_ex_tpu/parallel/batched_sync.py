@@ -73,24 +73,36 @@ def fanout_merge(
     )
 
 
-@partial(jax.jit, static_argnames=("kill_budget", "max_inserts", "scatter_compact"))
+@partial(
+    jax.jit,
+    static_argnames=("kill_budget", "max_inserts", "scatter_compact", "rows_sorted"),
+)
 def fanout_merge_packed(
     stacked: PackedStore,
     sl: RowSlice,
     kill_budget: int = 64,
     max_inserts: int | None = None,
-    scatter_compact: bool = False,
+    scatter_compact: bool = True,
+    rows_sorted: bool = False,
 ) -> MergeResult:
     """:func:`fanout_merge` over the packed entry layout — the chip-
     measured fast path (north-star A/B on TPU v5e: packed 8,852.8 vs
     columns 4,211.9 merges/s; BASELINE.md "Merge-kernel roofline"). Same
     per-neighbour remap + interval-join semantics, one ``[k, 8]`` vector
     scatter per neighbour instead of 7 scalar-column scatters.
-    ``scatter_compact=True`` additionally replaces the per-neighbour
-    top_k insert compaction with the cumsum+scatter form (the armed
-    ``BENCH_SCOMP`` candidate — parity-pinned; default flips if its
-    chip A/B wins)."""
-    fn = partial(merge_slice_packed, scatter_compact=scatter_compact)
+
+    ``scatter_compact`` (the promoted default — CPU full-config 1,060 →
+    2,024 merges/s over the top_k path; ``False`` restores top_k insert
+    compaction for A/Bs) replaces the per-neighbour top_k with the
+    cumsum+scatter form. ``rows_sorted=True`` vouches the slice's valid
+    rows are strictly ascending, unlocking that path's scatter hints —
+    see :func:`~delta_crdt_ex_tpu.ops.packed.merge_slice_packed_scomp`;
+    a false claim is XLA UB, so the default stays off."""
+    fn = partial(
+        merge_slice_packed,
+        scatter_compact=scatter_compact,
+        rows_sorted=rows_sorted,
+    )
     return jax.vmap(fn, in_axes=(0, None, None, None))(
         stacked, sl, kill_budget, max_inserts
     )
@@ -106,7 +118,8 @@ def fanout_merge_into(
     kill_budget: int = 16,
     on_grow=None,
     n_alive: int | None = None,
-    scatter_compact: bool = False,
+    scatter_compact: bool | None = None,
+    rows_sorted: bool = False,
 ):
     """The vmapped analog of ``merge_into``: merge one slice into N
     stacked neighbour states, escalating tiers via the shared
@@ -118,21 +131,31 @@ def fanout_merge_into(
     Accepts either layout: pass a :class:`PackedStore` stack (see
     :func:`pack_states`) to run the chip-measured fast path; growth and
     compaction escalate through the same tier policy on both.
-    ``scatter_compact`` selects the top_k-free insert compaction and is
-    packed-only (the column kernel has no such variant) — raising on a
-    column stack keeps an A/B from silently timing the wrong kernel.
+    ``scatter_compact`` selects the top_k-free insert compaction —
+    packed-only (the column kernel has no such variant), and the
+    PROMOTED default there (``None`` → on for packed stacks, off for
+    column stacks); passing ``True`` on a column stack raises so an A/B
+    can't silently time the wrong kernel. ``rows_sorted=True`` vouches
+    ascending valid slice rows (scatter-hint fast path; false claims
+    are XLA UB — see :func:`fanout_merge_packed`).
 
     Returns ``(stacked, last_result, n_retries)``."""
     if n_alive is None:
         n_alive = int(np.asarray(sl.alive).sum())
     packed = isinstance(stacked, PackedStore)
+    if scatter_compact is None:
+        scatter_compact = packed
     if scatter_compact and not packed:
         raise TypeError(
             "scatter_compact=True requires a PackedStore stack "
             "(pack_states); the column kernel has no scomp variant"
         )
     if packed:
-        merge = partial(fanout_merge_packed, scatter_compact=scatter_compact)
+        merge = partial(
+            fanout_merge_packed,
+            scatter_compact=scatter_compact,
+            rows_sorted=rows_sorted,
+        )
     else:
         merge = fanout_merge
     return tier_retry_merge(

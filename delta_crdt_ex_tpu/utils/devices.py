@@ -40,6 +40,23 @@ def backend_initialised(default: bool = True) -> bool:
         return default
 
 
+def pin_cpu_platform() -> None:
+    """Pin this process (and its children) to the CPU platform.
+
+    Both quirks from the module docstring in one place: the env vars
+    alone are NOT enough on the ambient image (the boot hook pins
+    ``jax_platforms`` ahead of them), and the config update alone does
+    not propagate to subprocesses — so set both, and clear the pool
+    address so the accelerator boot hook never fires either way.
+    Call before the first backend initialisation."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 def force_cpu_devices(n: int) -> None:
     """Force jax onto the CPU platform with ``n`` virtual devices.
 
@@ -54,13 +71,7 @@ def force_cpu_devices(n: int) -> None:
     else:
         flags = f"{flags} --{_FLAG}={n}".strip()
     os.environ["XLA_FLAGS"] = flags
-    # config updates don't propagate to subprocesses — keep the env var in
-    # step so children inherit the CPU platform too
-    os.environ["JAX_PLATFORMS"] = "cpu"
-
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
+    pin_cpu_platform()
     if not backend_initialised(default=True):  # unknown — verify via reset
         return
 

@@ -1,7 +1,49 @@
-"""Shared helper for the runnable demos."""
+"""Shared helpers for the runnable demos."""
 
+import os
+import subprocess
 import sys
 import time
+
+
+def ensure_backend(timeout: float | None = None) -> None:
+    """Make the demo runnable whatever backend the environment has.
+
+    The ambient image configures an accelerator backend whose device
+    claim goes through an external pool; when the pool is down, the
+    FIRST jax operation hangs and the demo dies with ``Unable to
+    initialize backend`` — so probe the claim in a subprocess with a
+    watchdog (the same pattern ``bench.py`` uses) and fall back to a
+    loudly-labelled CPU run instead. Call before any jax work; no-op
+    when the process already runs on CPU.
+    """
+    from delta_crdt_ex_tpu.utils.devices import pin_cpu_platform
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # even an explicit JAX_PLATFORMS=cpu needs the full pin: the
+        # ambient boot hook reads its own pool var ahead of the env
+        pin_cpu_platform()
+        return
+    if timeout is None:
+        timeout = float(os.environ.get("EXAMPLES_CLAIM_TIMEOUT", "60"))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout,
+            capture_output=True,
+        )
+        if proc.returncode == 0:
+            return
+        reason = proc.stderr.decode(errors="replace").strip().splitlines()
+        reason = reason[-1] if reason else f"exit {proc.returncode}"
+    except subprocess.TimeoutExpired:
+        reason = f"device claim probe hung >{timeout:.0f}s (pool down or wedged)"
+    print(
+        f"[demo] configured accelerator backend unreachable ({reason}) — "
+        "running on CPU instead (labelled fallback)",
+        flush=True,
+    )
+    pin_cpu_platform()
 
 
 def wait_until(pred, what: str, timeout: float = 30.0) -> None:

@@ -13,13 +13,20 @@ This demo speaks the kernel vocabulary (uint64 key hashes / uint32
 value hashes, like `bench.py`); the replica runtime (`start_link`)
 wraps the same kernels for arbitrary Python keys and values.
 
-Run (CPU or a real chip as-is):
-  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu PYTHONPATH=. \
-  python examples/bulk_fanout.py
+Run: python examples/bulk_fanout.py
+(runs on the configured accelerator when its pool is reachable, else
+falls back to a labelled CPU run; JAX_PLATFORMS=cpu forces CPU)
 """
 
 import dataclasses
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from examples._util import ensure_backend
+
+ensure_backend()
 
 import jax.numpy as jnp
 import numpy as np

@@ -7,10 +7,15 @@ control plane stays on host. On a CPU host this runs over virtual
 devices; the same program on a TPU pod keeps slice bytes off the host
 entirely.
 
-Run: PYTHONPATH=. python examples/device_plane.py
-(CPU: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
- XLA_FLAGS=--xla_force_host_platform_device_count=4)
+Run: python examples/device_plane.py
+(defaults to 4 virtual CPU devices; a pre-forced environment —
+JAX_PLATFORMS/XLA_FLAGS already set — keeps its own devices)
 """
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from delta_crdt_ex_tpu.utils.devices import backend_initialised
 

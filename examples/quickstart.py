@@ -1,11 +1,19 @@
 """Two in-process replicas, the reference README flow.
 
-Run: PYTHONPATH=. python examples/quickstart.py
-(CPU works fine: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu)
+Run: python examples/quickstart.py
+(runs on the configured accelerator when its pool is reachable, else
+falls back to a labelled CPU run; JAX_PLATFORMS=cpu forces CPU)
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from examples._util import ensure_backend, wait_until
+
+ensure_backend()
+
 import delta_crdt_ex_tpu as dc
-from examples._util import wait_until
 
 c1 = dc.start_link(dc.AWLWWMap, sync_interval=0.02)
 c2 = dc.start_link(dc.AWLWWMap, sync_interval=0.02)

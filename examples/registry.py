@@ -13,14 +13,21 @@ a node dies. This demo builds exactly that on the TPU-native runtime:
 - a node crash fires the neighbour monitor (``Down``), and the survivor
   removes the dead node's registrations — the Horde cleanup pattern.
 
-Run: PYTHONPATH=. python examples/registry.py
-(CPU: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu)
+Run: python examples/registry.py
+(runs on the configured accelerator when its pool is reachable, else
+falls back to a labelled CPU run; JAX_PLATFORMS=cpu forces CPU)
 """
 
+import os
+import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from examples._util import ensure_backend, wait_until
+
+ensure_backend()
+
 import delta_crdt_ex_tpu as dc
-from examples._util import wait_until
 
 nodes = {}
 for node in ("node-a", "node-b", "node-c"):

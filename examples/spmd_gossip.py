@@ -6,13 +6,18 @@ then `gossip_delta_step` exchanges leaf digests with its ring
 neighbour, requests only the differing buckets, and joins the returned
 slice shard-locally. N-1 steps converge an N-device ring.
 
-Run on 8 virtual CPU devices (or a real multi-chip mesh as-is):
-  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
-  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-  PYTHONPATH=. python examples/spmd_gossip.py
+Run: python examples/spmd_gossip.py
+(defaults to 8 virtual CPU devices; a pre-forced environment —
+JAX_PLATFORMS/XLA_FLAGS already set — keeps its own devices, so the
+same file runs unchanged on a real multi-chip mesh)
 """
 
+import os
+import sys
+
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from delta_crdt_ex_tpu.utils.devices import backend_initialised
 

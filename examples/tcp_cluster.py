@@ -3,14 +3,21 @@ feed — the capabilities a reference user reaches for in production:
 `{name, node}`-style remote addressing, `on_diffs` change feed,
 `storage_module` crash recovery.
 
-Run: PYTHONPATH=. python examples/tcp_cluster.py
-(CPU works fine: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu)
+Run: python examples/tcp_cluster.py
+(runs on the configured accelerator when its pool is reachable, else
+falls back to a labelled CPU run; JAX_PLATFORMS=cpu forces CPU)
 """
 
+import os
+import sys
 import tempfile
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from examples._util import ensure_backend, wait_until
+
+ensure_backend()
+
 import delta_crdt_ex_tpu as dc
-from examples._util import wait_until
 from delta_crdt_ex_tpu.runtime.storage import FileStorage
 from delta_crdt_ex_tpu.runtime.tcp_transport import TcpTransport
 
