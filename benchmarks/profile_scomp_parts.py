@@ -1,17 +1,19 @@
 """Phase-level attribution of the promoted scomp merge at the bench
-config — what eats the ~0.5 s/call left on CPU (and the ~113 ms/call
-left on chip) now that top_k is gone.
+config — what eats the remaining per-call time on CPU (and the ~113
+ms/call left on chip) now that both top_k sorts are gone.
 
-Times (a) the full bench merge_chunk (merge + flags + roots), (b) the
-merge alone, (c) the digest-tree roots alone, then isolated synthetic
-probes for the scomp-specific terms: the per-neighbour [G,9] compaction
-scatter over the padded grid, the grid cumsum, and the main [k,8]
-record scatter. G = u·s is ~8x the real entry count at the bench shape
-(8,192 keys spread over ~6.4k buckets padded to 8,192 rows x 8 lanes),
-so the compaction term pays that padding tax per neighbour per call.
+Times (a) the full bench merge_chunk (merge + roots), (b) the merge
+alone, (c) the digest-tree roots alone, then isolated synthetic probes
+for the scomp-v2-specific terms: the per-neighbour [G,2] pair
+compaction scatter, the [k,7] payload gather from the hoisted
+slice-only planes, the grid cumsum, and the main [k,8] record scatter.
+G = u·s is ~8x the real entry count at the bench shape (8,192 keys
+spread over ~6.4k buckets padded to 8,192 rows x 8 lanes), so the
+G-sized terms pay that padding tax per neighbour per call.
 
 Run: JAX_PLATFORMS=cpu python -m benchmarks.profile_scomp_parts
-(SCOMP_PARTS_NEIGHBOURS=16 shrinks the fan-in; numbers scale linearly.)
+(SCOMP_PARTS_NEIGHBOURS=16 shrinks the fan-in; numbers scale roughly
+linearly, with a superlinear tail at 64 from the 4.3 GB working set.)
 """
 
 from __future__ import annotations
@@ -19,6 +21,7 @@ from __future__ import annotations
 import os
 import sys
 import time
+from functools import partial
 
 import numpy as np
 
@@ -56,6 +59,24 @@ def timed(fn, n=6):
     return (time.perf_counter() - t0) / n
 
 
+def timed_chain(step, carry, args_list):
+    """Donated-carry timing: ``step(carry, args) -> carry`` jitted with
+    ``donate_argnums=(0,)``, warmed on ``args_list[0]`` and timed over
+    the rest — the probe measures the in-place update the bench
+    actually runs (without donation a scatter pays a full operand copy
+    per call, where the first version of this script lost 0.6 s/call
+    and attributed the copy, not the op). The probe outputs must be
+    RETURNED by ``step`` or XLA dead-code-eliminates the work being
+    timed."""
+    carry = step(carry, args_list[0])
+    jax.block_until_ready(carry)
+    t0 = time.perf_counter()
+    for a in args_list[1:]:
+        carry = step(carry, a)
+    jax.block_until_ready(carry)
+    return (time.perf_counter() - t0) / (len(args_list) - 1)
+
+
 def main():
     L = 1 << TREE_DEPTH
     B = BIN_CAP
@@ -67,12 +88,22 @@ def main():
                          replica_capacity=RCAP)
     one = jax.jit(pack)(one)
     jax.block_until_ready(one)
-    stacked = jax.tree_util.tree_map(
-        lambda x: jnp.copy(jnp.broadcast_to(x, (NEIGHBOURS,) + x.shape)), one
-    )
-    jax.block_until_ready(stacked)
 
-    slices, _ = interval_delta_stream(22, rng, 1, GROUP * DELTA, L, bin_width=8)
+    def fresh_stack():
+        # each donated-carry probe consumes its stack — rebuild per probe
+        st = jax.tree_util.tree_map(
+            lambda x: jnp.copy(jnp.broadcast_to(x, (NEIGHBOURS,) + x.shape)), one
+        )
+        jax.block_until_ready(st)
+        return st
+
+    # fresh dots per timed call (like the bench): re-merging one slice
+    # into an already-covering state does no insert work and would
+    # time the wrong kernel
+    n_timed = 6
+    slices, _ = interval_delta_stream(
+        22, rng, n_timed + 2, GROUP * DELTA, L, bin_width=8
+    )
     sl = slices[0]
     u, s_w = sl.key.shape
     G = u * s_w
@@ -81,47 +112,77 @@ def main():
 
     mfn = lambda st, s: merge_slice_packed_scomp(st, s, 8, k, rows_sorted=True)
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0,))
     def f_full(states, s):
         res = jax.vmap(mfn, in_axes=(0, None))(states, s)
         roots = jax.vmap(lambda lf: tree_from_leaves(lf)[0][0])(res.state.leaf)
-        return res.ok, roots
+        # roots must flow into the carry or XLA prunes the whole tree
+        # fold and this probe times the same program as f_merge
+        return res.state, roots
 
-    log(f"merge+roots x{NEIGHBOURS}: {timed(lambda: f_full(stacked, sl))*1e3:.1f} ms")
+    def full_step(carry, s):
+        return f_full(carry[0], s)
 
-    @jax.jit
+    log(
+        f"merge+roots x{NEIGHBOURS} (donated): "
+        f"{timed_chain(full_step, (fresh_stack(), None), slices[: n_timed + 1])*1e3:.1f} ms"
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
     def f_merge(states, s):
         res = jax.vmap(mfn, in_axes=(0, None))(states, s)
-        return res.ok, res.state.leaf
+        return res.state
 
-    log(f"merge only  x{NEIGHBOURS}: {timed(lambda: f_merge(stacked, sl))*1e3:.1f} ms")
+    log(
+        f"merge only  x{NEIGHBOURS} (donated): "
+        f"{timed_chain(f_merge, fresh_stack(), slices[: n_timed + 1])*1e3:.1f} ms"
+    )
 
     @jax.jit
     def f_roots(states):
         return jax.vmap(lambda lf: tree_from_leaves(lf)[0][0])(states.leaf)
 
-    log(f"roots only  x{NEIGHBOURS}: {timed(lambda: f_roots(stacked))*1e3:.1f} ms")
+    roots_stack = fresh_stack()
+    log(f"roots only  x{NEIGHBOURS}: {timed(lambda: f_roots(roots_stack))*1e3:.1f} ms")
 
-    # --- isolated synthetic probes (shapes match the real kernel) -------
+    # --- isolated synthetic probes (shapes match the v2 kernel) ---------
     flatN = jnp.asarray(
         rng.integers(0, L * B, (NEIGHBOURS, G), np.int64)
     )
-    planesN = jnp.asarray(rng.integers(0, 1 << 32, (NEIGHBOURS, G, 9), np.uint32))
 
     @jax.jit
-    def f_compact_scatter(fl, pl):
-        def one(f, p):
+    def f_pair_compact(fl):
+        # per-neighbour [G,2] (flat, grid-index) pair compaction — the
+        # only G-sized scatter v2 keeps per neighbour
+        def one(f):
             ins_flat = f < (L * B) // 2
             rank = jnp.cumsum(ins_flat.astype(jnp.int32)) - 1
             dest = jnp.where(ins_flat, rank, k)
+            pair = jnp.stack(
+                [f.astype(jnp.uint32), jnp.arange(G, dtype=jnp.uint32)], -1
+            )
             return (
-                jnp.zeros((k + 1, 9), jnp.uint32).at[dest].set(p, mode="drop")
+                jnp.zeros((k + 1, 2), jnp.uint32).at[dest].set(pair, mode="drop")
             )[:k]
-        return jax.vmap(one)(fl, pl)
+        return jax.vmap(one)(fl)
 
     log(
-        f"[G={G},9] cumsum+compaction scatter x{NEIGHBOURS}: "
-        f"{timed(lambda: f_compact_scatter(flatN, planesN))*1e3:.1f} ms"
+        f"[G={G},2] pair compaction scatter x{NEIGHBOURS}: "
+        f"{timed(lambda: f_pair_compact(flatN))*1e3:.1f} ms"
+    )
+
+    # the [k,7] payload gather from the SHARED (slice-only, hoisted)
+    # [G,7] plane pack — per-neighbour indices, one source table
+    planes7 = jnp.asarray(rng.integers(0, 1 << 32, (G, 7), np.uint32))
+    srcN = jnp.asarray(rng.integers(0, G, (NEIGHBOURS, k), np.int64))
+
+    @jax.jit
+    def f_payload_gather(src):
+        return jax.vmap(lambda s: planes7[s])(src)
+
+    log(
+        f"payload [k={k},7] gather x{NEIGHBOURS}: "
+        f"{timed(lambda: f_payload_gather(srcN))*1e3:.1f} ms"
     )
 
     @jax.jit
@@ -130,28 +191,53 @@ def main():
 
     log(f"[G] cumsum x{NEIGHBOURS}: {timed(lambda: f_cumsum(flatN))*1e3:.1f} ms")
 
-    # the planes concatenate alone (9 [G]-plane writes per neighbour)
-    @jax.jit
-    def f_planes(pl):
-        return jax.vmap(lambda p: jnp.concatenate([p[:, i:i+1] for i in range(9)], axis=-1))(pl)
-
-    log(f"[G,9] plane concat x{NEIGHBOURS}: {timed(lambda: f_planes(planesN))*1e3:.1f} ms")
-
-    idxk = jnp.asarray(
-        np.sort(rng.choice(L * B, size=(NEIGHBOURS, k), replace=True), axis=1).astype(np.int64)
-    )
-    vals8 = jnp.asarray(rng.integers(0, 1 << 32, (NEIGHBOURS, k, 8), np.uint32))
-    tblN = jnp.zeros((NEIGHBOURS, L * B, 8), jnp.uint32)
+    # the hoisted plane pack itself (once per CALL, not per neighbour)
+    key_col = jnp.asarray(rng.integers(0, 1 << 63, G, np.uint64))
+    ts_col = jnp.asarray(rng.integers(0, 1 << 62, G, np.int64))
+    u32_cols = [jnp.asarray(rng.integers(0, 1 << 32, G, np.uint32)) for _ in range(3)]
 
     @jax.jit
-    def f_main_scatter(tb, ix, v):
-        def one(t, i, vv):
-            return t.at[i].set(vv, mode="drop", indices_are_sorted=True)
-        return jax.vmap(one)(tb, ix, v)
+    def f_planes7(kc, tc, cs):
+        return jnp.concatenate(
+            [jax.lax.bitcast_convert_type(kc[:, None], jnp.uint32).reshape(G, 2),
+             jax.lax.bitcast_convert_type(tc[:, None], jnp.uint32).reshape(G, 2)]
+            + [c[:, None] for c in cs],
+            axis=-1,
+        )
 
     log(
-        f"main [k={k},8] record scatter x{NEIGHBOURS}: "
-        f"{timed(lambda: f_main_scatter(tblN, idxk, vals8))*1e3:.1f} ms"
+        f"[G,7] plane pack (once/call): "
+        f"{timed(lambda: f_planes7(key_col, ts_col, u32_cols))*1e3:.1f} ms"
+    )
+
+    # sorted unique per-neighbour indices: the real kernel's hint-path
+    # precondition (ascending rows, unique slots)
+    idxk = jnp.asarray(
+        np.stack(
+            [np.sort(rng.choice(L * B, size=k, replace=False)) for _ in range(NEIGHBOURS)]
+        ).astype(np.int64)
+    )
+    vals8 = jnp.asarray(rng.integers(0, 1 << 32, (NEIGHBOURS, k, 8), np.uint32))
+
+    def scatter_probe(name, hints):
+        @partial(jax.jit, donate_argnums=(0,))
+        def f(tb, _):
+            def one(t, i, vv):
+                return t.at[i].set(
+                    vv, mode="drop",
+                    indices_are_sorted=hints, unique_indices=hints,
+                )
+            return jax.vmap(one)(tb, idxk, vals8)
+
+        tb = jnp.zeros((NEIGHBOURS, L * B, 8), jnp.uint32)
+        ms = timed_chain(f, tb, [None] * (n_timed + 1)) * 1e3
+        log(f"{name}: {ms:.1f} ms")
+
+    scatter_probe(
+        f"main [k={k},8] record scatter x{NEIGHBOURS} (donated, hints)", True
+    )
+    scatter_probe(
+        f"main [k={k},8] record scatter x{NEIGHBOURS} (donated, no hints)", False
     )
 
 

@@ -126,23 +126,35 @@ def _row_table_lookup(tbl: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
 
 
 def flagged_first_order(flags: jnp.ndarray, budget: int) -> jnp.ndarray:
-    """int32[min(budget, n)] indices: flagged positions first, ascending
-    index within each group — the selection a stable ``argsort(~flags)``
-    slice would make, via ``top_k`` over a packed priority key
-    (O(n log budget)). Keys are disjoint across groups and distinct
-    within (flagged: ``[2n, 3n)``, unflagged: ``[0, n)``), so the order
-    is fully determined; callers that need *all* flagged entries must
-    check the flagged count against ``budget`` themselves."""
+    """int32[min(budget, n)] indices: the first ``budget`` flagged
+    positions in ascending order, unfilled slots holding a guaranteed-
+    UNFLAGGED index. Every caller masks slots through ``flags[order]``
+    (the kill passes via ``k_valid``, the gossip frontier via ``want``),
+    so only the flagged prefix is observable — which lets the selection
+    be a cumsum rank + one small scatter instead of the previous
+    ``top_k`` over a packed priority key: XLA:CPU lowers ``top_k`` to a
+    full O(n log n) sort (~22% of the whole packed merge at the bench
+    shape), and TPU pays a multi-pass sort too. Callers that need *all*
+    flagged entries must check the flagged count against ``budget``
+    themselves.
+
+    The filler is ``argmin(flags)`` — the first unflagged index — NOT an
+    arbitrary constant: a filler that aliased a flagged row would enter
+    the kill pass unmasked next to the row's real slot, and its
+    ``leaf.at[rows].add`` would double-subtract that row's digest. When
+    every position is flagged there are no unfilled slots (the ranks
+    cover the whole budget), so the degenerate ``argmin`` result is
+    never observable."""
     n = flags.shape[0]
-    # key range is [0, 3n): past int32 it would overflow and silently
-    # scramble the order — unreachable at current geometries (leaves are
-    # ~2^14) but the helper is generic, so guard it
-    assert 3 * n < 2**31, f"flagged_first_order int32 key overflow: n={n}"
-    prio = flags.astype(jnp.int32) * (2 * n) + jnp.arange(
-        n - 1, -1, -1, dtype=jnp.int32
-    )
-    _, order = jax.lax.top_k(prio, min(budget, n))
-    return order
+    kb = min(budget, n)
+    rank = jnp.cumsum(flags.astype(jnp.int32)) - 1
+    dest = jnp.where(flags, jnp.minimum(rank, kb), kb)  # kb = trash slot
+    filler = jnp.argmin(flags).astype(jnp.int32)
+    return (
+        jnp.full((kb + 1,), filler, jnp.int32)
+        .at[dest]
+        .set(jnp.arange(n, dtype=jnp.int32), mode="drop")
+    )[:kb]
 
 
 def _row_amin(node, ctr, alive, u, r):
