@@ -43,6 +43,7 @@ import os
 import signal
 import sys
 import time
+from functools import partial
 
 import numpy as np
 
@@ -221,9 +222,7 @@ def bench_tpu(seed=0, on_primary=None):
             # interval_delta_stream rows come from np.unique → the valid
             # prefix is strictly ascending, so the scatter-hint fast
             # path's precondition holds for every bench slice
-            from functools import partial as _partial
-
-            merge_fn = _partial(merge_slice_packed_scomp, rows_sorted=True)
+            merge_fn = partial(merge_slice_packed_scomp, rows_sorted=True)
             log("merge layout: packed, top_k-free scatter compaction")
         else:
             merge_fn = merge_slice_packed
@@ -404,9 +403,7 @@ def bench_tpu(seed=0, on_primary=None):
                 # top_k primary (BENCH_SCOMP=0) → the A/B still answers
                 # the live question, scomp-vs-top_k (columns-vs-packed
                 # was settled by the r4 chip session, BASELINE.md)
-                from functools import partial as _p
-
-                alt_name, alt_fn = "packed_scomp", _p(
+                alt_name, alt_fn = "packed_scomp", partial(
                     merge_slice_packed_scomp, rows_sorted=True
                 )
             else:

@@ -72,11 +72,21 @@ def main():
     # initialised before the guarded block: the scomp section below
     # reads these even when the north-star artifact is absent/errored
     # (the resume-matrix scenario that only runs the scomp A/B)
-    cols = pkd = fus = unf = None
+    cols = pkd = fus = unf = scp_ns = tk_ns = None
     if ns is not None and "error" not in ns:
         run_tag = "EARLIER session" if ns_stale else "same run"
         cols = ns.get("columns_merges_per_sec")
         pkd = ns.get("packed_merges_per_sec")
+        # the resume matrix copies the scomp run's artifact in as the
+        # window's north-star — its A/B pair is scomp-vs-top_k
+        scp_ns = ns.get("packed_scomp_merges_per_sec")
+        tk_ns = ns.get("packed_topk_merges_per_sec")
+        if scp_ns and tk_ns:
+            out.append(
+                f"scomp A/B ({run_tag}): packed_topk {tk_ns} vs packed_scomp "
+                f"{scp_ns} merges/sec ({scp_ns / tk_ns:.2f}x) — winner "
+                f"'{ns.get('layout')}' is the headline value"
+            )
         if cols and pkd:
             out.append(
                 f"layout A/B ({run_tag}): columns {cols} vs packed {pkd} "
@@ -112,7 +122,13 @@ def main():
                 f"scomp run: {sc.get('value')} merges/sec "
                 f"(layout {sc.get('layout')}, no in-run A/B fields)"
             )
-    if ns is not None and "error" not in ns and not (cols and pkd) and not (fus and unf):
+    if (
+        ns is not None
+        and "error" not in ns
+        and not (cols and pkd)
+        and not (fus and unf)
+        and not (scp_ns and tk_ns)
+    ):
         out.append("layout A/B: fields absent (BENCH_AB=0 or pre-A/B artifact)")
 
     rows = []

@@ -145,3 +145,38 @@ def test_insert_compaction_tier_is_transparent():
         if int(r_none.n_inserted) > 1:
             r_small = merge_slice(a.state, sl, kill_budget=L, max_inserts=1)
             assert not bool(r_small.ok) and bool(r_small.need_ins_tier), trial
+
+
+def test_flagged_first_order_filler_never_flagged():
+    """The cumsum-rank rewrite of ``flagged_first_order`` fills unused
+    budget slots with ``argmin(flags)`` — this pins the invariant the
+    kill pass depends on: a filler slot must NEVER alias a flagged row,
+    or the row would be processed twice and ``leaf.at[rows].add`` would
+    double-subtract its digest (the top_k version filled with unflagged
+    rows; the replacement must keep that property in every shape)."""
+    from delta_crdt_ex_tpu.ops.binned import flagged_first_order
+
+    rng = np.random.default_rng(7)
+    cases = [
+        np.array([True] + [False] * 15),          # the alias hazard: row 0 flagged
+        np.array([False] * 16),                   # none flagged
+        np.array([True] * 16),                    # all flagged
+        np.array([False, True] * 8),              # alternating
+        np.array([False] * 15 + [True]),          # last-only
+    ] + [rng.random(16) < p for p in (0.1, 0.5, 0.9)]
+    for budget in (1, 4, 16, 32):
+        for ci, flags in enumerate(cases):
+            order = np.asarray(flagged_first_order(jnp.asarray(flags), budget))
+            kb = min(budget, flags.size)
+            assert order.shape == (kb,), (ci, budget)
+            assert ((order >= 0) & (order < flags.size)).all(), (ci, budget)
+            n_flagged = int(flags.sum())
+            expect = np.flatnonzero(flags)[: min(kb, n_flagged)]
+            got = order[: min(kb, n_flagged)]
+            # flagged prefix: the first `budget` flagged rows, ascending
+            assert np.array_equal(got, expect), (ci, budget, order, flags)
+            # THE invariant: no slot past the flagged prefix may hold a
+            # flagged row (masking via flags[order] must hide fillers)
+            assert not flags[order[min(kb, n_flagged):]].any(), (
+                ci, budget, order, flags,
+            )
