@@ -319,3 +319,65 @@ def test_packed_flags_parity_on_overflow():
     r2 = merge_slice_packed(pack(st_col), sl, kill_budget=L, max_inserts=8)
     assert bool(r1.need_ins_tier) and bool(r2.need_ins_tier)
     assert bool(r1.ok) == bool(r2.ok) == False  # noqa: E712
+
+
+def test_scomp_parity_shuffled_rows():
+    """Unsorted slice rows through the scomp path, ``rows_sorted`` left
+    at its safe False default: the cumsum compaction preserves grid
+    order, so with shuffled rows the compacted flat indices are NOT
+    ascending — the hint gate (ADVICE r4: a false sorted/unique hint is
+    XLA UB) must keep the scatter correct. Result must stay
+    bit-identical to the top_k packed kernel AND the column kernel on
+    the same shuffled slice."""
+    from delta_crdt_ex_tpu.ops.packed import merge_slice_packed_scomp
+
+    rng = np.random.default_rng(12)
+    for trial in range(8):
+        L = 16
+        a, b = random_divergent_pair(rng, L=L)
+        rows = jnp.asarray(rng.permutation(L).astype(np.int32))
+        sl = extract_rows(b.state, rows)
+        st_pk = pack(a.state)
+        for max_inserts in (8, 256):  # 8 exercises the overflow flag
+            r1 = merge_slice_packed(
+                st_pk, sl, kill_budget=L, max_inserts=max_inserts
+            )
+            r2 = merge_slice_packed_scomp(
+                st_pk, sl, kill_budget=L, max_inserts=max_inserts
+            )
+            assert_variant_parity(r1, r2, (trial, max_inserts))
+        # the loop's last r2 is the 256-case result — compare it against
+        # the column kernel too (same slice, third implementation)
+        r_col = merge_slice(a.state, sl, kill_budget=L, max_inserts=256)
+        assert_variant_parity(r_col, r2, ("col", trial))
+
+
+def test_scomp_parity_sorted_rows_vouched():
+    """``rows_sorted=True`` — the hint fast path ``entry()`` and the
+    bench run in production — must stay bit-identical to both the
+    unvouched scomp call and the top_k kernel on ascending-row slices.
+    This is the only test exercising the vouched hints: if a future
+    change breaks the ascending/unique compacted-index invariant (e.g.
+    reordering the scomp branch's pos computation), THIS fails before
+    entry() scatters with false XLA hints on hardware."""
+    from delta_crdt_ex_tpu.ops.packed import merge_slice_packed_scomp
+
+    rng = np.random.default_rng(13)
+    for trial in range(8):
+        L = 16
+        a, b = random_divergent_pair(rng, L=L)
+        sl = extract_rows(b.state, jnp.arange(L, dtype=jnp.int32))
+        st_pk = pack(a.state)
+        for max_inserts in (8, 256):
+            r_ref = merge_slice_packed(
+                st_pk, sl, kill_budget=L, max_inserts=max_inserts
+            )
+            r_v = merge_slice_packed_scomp(
+                st_pk, sl, kill_budget=L, max_inserts=max_inserts,
+                rows_sorted=True,
+            )
+            assert_variant_parity(r_ref, r_v, (trial, max_inserts, "vouched"))
+            r_unv = merge_slice_packed_scomp(
+                st_pk, sl, kill_budget=L, max_inserts=max_inserts
+            )
+            assert_variant_parity(r_unv, r_v, (trial, max_inserts, "unvouched"))
