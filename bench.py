@@ -659,10 +659,20 @@ def _emit(obj: dict) -> None:
     The emitted flag flips only after the print completes: a SIGTERM
     landing mid-emission lets the handler's line still go out (the
     driver parses the LAST line, so a rare double emission is harmless;
-    an empty stdout is not)."""
+    an empty stdout is not). Every line carries its emission time and
+    the GROUP knob: the resume matrix's skip gate
+    (``benchmarks.artifact``) classifies freshness by the embedded
+    ``utc`` (file mtimes reset on checkout), and probe artifacts are
+    meaningless without the grouping they measured."""
     global _EMITTED
     if _EMITTED:
         return
+    import datetime
+
+    obj.setdefault(
+        "utc", datetime.datetime.now(datetime.timezone.utc).isoformat()
+    )
+    obj.setdefault("group", GROUP)
     print(json.dumps(obj), flush=True)
     _EMITTED = True
 

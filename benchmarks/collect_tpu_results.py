@@ -131,6 +131,22 @@ def main():
     ):
         out.append("layout A/B: fields absent (BENCH_AB=0 or pre-A/B artifact)")
 
+    # the GROUP=32 dispatch-amortization probe (resume_tpu_matrix.sh):
+    # compare against the window's GROUP=16 north-star when present
+    g32 = _load(os.path.join(REPO, "benchmarks", "results", "group32_v2.json"))
+    if g32 is not None and "error" not in g32 and g32.get("value"):
+        line = (
+            f"group32 probe: {g32['value']} merges/sec "
+            f"(layout {g32.get('layout')}, group {g32.get('group', 32)})"
+        )
+        if ns is not None and "error" not in ns and ns.get("value"):
+            line += (
+                f" vs north-star {ns['value']} "
+                f"({g32['value'] / ns['value']:.2f}x) — promote BENCH_GROUP=32 "
+                "as the bench default if it wins on chip"
+            )
+        out.append(line)
+
     rows = []
     for path in sorted(glob.glob(os.path.join(REPO, "benchmarks", "results", "*.tpu.json"))):
         data = _load(path)

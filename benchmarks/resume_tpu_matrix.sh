@@ -82,32 +82,50 @@ else
   fi
 fi
 
+# shared bench.py probe runner: artifact-freshness skip gate (the
+# embedded-utc predicate run_row uses — a git-committed log marker
+# would survive a fresh checkout whose untracked artifact did not,
+# permanently skipping the probe), env-wrapped run, ok_line validation
+probe_fresh() { # outfile -> 0 iff fresh AND not a failure artifact
+  [ -f "$1" ] || return 1
+  [ "$(timeout 60 python -m benchmarks.artifact "$1" 2>/dev/null)" = "fresh" ] \
+    || return 1
+  ! grep -q '"error"' "$1"
+}
+run_bench_probe() { # name timeout outfile [env...]
+  local name="$1" tmo="$2" out="$3"; shift 3
+  if probe_fresh "$out"; then
+    say "$name: fresh artifact exists, skipping"
+    return 0
+  fi
+  say "$name: running (timeout ${tmo}s)"
+  env "$@" timeout "$tmo" python bench.py > "$out" 2>>"$LOG"
+  local line
+  line=$(tail -1 "$out" 2>/dev/null)
+  if ok_line "$line"; then
+    say "$name: $line"
+    return 0
+  fi
+  say "$name FAILED: $line"
+  return 1
+}
+
 # north-star with the PROMOTED scomp primary and top_k as the in-run
 # alternate (BENCH_SCOMP defaults on since round 5): one run decides
 # whether the promotion holds on chip AND refreshes the north-star —
 # a success is copied to northstar.tpu.json so the digest and BASELINE
 # see it as this window's headline.
-if grep -q "scomp A/B:" "$LOG" 2>/dev/null; then
-  say "scomp A/B: already captured, skipping"
-else
-  say "scomp north-star + A/B bench (promoted scomp vs top_k)"
-  BENCH_SCOMP=1 BENCH_TOTAL_BUDGET=2200 BENCH_CLAIM_TIMEOUT=120 \
-  BENCH_CLAIM_ATTEMPTS=2 BENCH_TPU_TIMEOUT=2000 BENCH_NO_CPU_FALLBACK=1 \
-    timeout 2400 python bench.py > benchmarks/results/scomp_ab.json 2>>"$LOG"
-  SCOMP_LINE=$(tail -1 benchmarks/results/scomp_ab.json 2>/dev/null)
-  if ok_line "$SCOMP_LINE"; then
-    say "scomp A/B: $SCOMP_LINE"
-    cp benchmarks/results/scomp_ab.json benchmarks/results/northstar.tpu.json
-    cp benchmarks/results/scomp_ab.json /tmp/northstar.json 2>/dev/null || true
-    say "north-star artifact refreshed from the scomp run"
-  else
-    say "scomp A/B FAILED: $SCOMP_LINE"
-  fi
+if run_bench_probe "scomp A/B" 2400 benchmarks/results/scomp_ab.json \
+    BENCH_SCOMP=1 BENCH_TOTAL_BUDGET=2200 BENCH_CLAIM_TIMEOUT=120 \
+    BENCH_CLAIM_ATTEMPTS=2 BENCH_TPU_TIMEOUT=2000 BENCH_NO_CPU_FALLBACK=1; then
+  cp benchmarks/results/scomp_ab.json benchmarks/results/northstar.tpu.json
+  cp benchmarks/results/scomp_ab.json /tmp/northstar.json 2>/dev/null || true
+  say "north-star artifact refreshed from the scomp run"
 fi
 
 # attribution of the promoted kernel's remaining per-call cost (the
-# [G,9] compaction scatter is the CPU-side suspect; chip numbers decide
-# the next lever — see benchmarks/profile_scomp_parts.py)
+# pair-compaction scatter + coverage preamble are the CPU-side terms;
+# chip numbers decide the next lever — benchmarks/profile_scomp_parts.py)
 if grep -q "scomp-parts done" "$LOG" 2>/dev/null; then
   say "profile_scomp_parts: already done, skipping"
 else
@@ -118,6 +136,16 @@ else
     say "profile_scomp_parts FAILED (rc=$?)"
   fi
 fi
+
+# GROUP=32 re-probe under scomp v2: r4 rejected 32 for the TOP_K kernel
+# (its sort is superlinear in slice size) — that term is gone, v2's
+# G-sized work is linear, and doubling GROUP doubles dispatch
+# amortization; CPU measures a wash (3,070 vs 3,099 median), so the
+# chip decides. Lane width pinned to 8 like the r4 probe.
+run_bench_probe "group32 v2" 1600 benchmarks/results/group32_v2.json \
+  BENCH_GROUP=32 BENCH_BIN_WIDTH=8 BENCH_AB=0 BENCH_TOTAL_BUDGET=1500 \
+  BENCH_CLAIM_TIMEOUT=120 BENCH_CLAIM_ATTEMPTS=2 BENCH_TPU_TIMEOUT=1300 \
+  BENCH_NO_CPU_FALLBACK=1 || true
 
 say "graft entry compile check (single chip)"
 timeout 900 python -c "
