@@ -559,6 +559,34 @@ class Budget:
         return max(0.0, self.total - self.elapsed() - reserve)
 
 
+def _run_with_grace(cmd: list, timeout_s: float, env: dict | None = None):
+    """subprocess with a SIGTERM-first watchdog.
+
+    ``subprocess.run(timeout=...)`` SIGKILLs on expiry — and a SIGKILL
+    to a process holding (or awaiting) the device claim is the exact
+    hazard that preceded round 4's 9-hour pool outage. Terminate first
+    so the child can unwind (emit its artifact, release the claim via
+    normal teardown), escalate to kill only after a grace period.
+    Returns ``(returncode | None, stdout, stderr, timed_out)``."""
+    import subprocess
+
+    proc = subprocess.Popen(
+        cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE
+    )
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+        return proc.returncode, stdout, stderr, False
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            stdout, stderr = proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            log("graceful stop timed out after 30s — escalating to SIGKILL")
+            proc.kill()
+            stdout, stderr = proc.communicate()
+        return None, stdout, stderr, True
+
+
 def _device_backend_usable(budget: Budget, reserve: float,
                            timeout_s: float, attempts: int) -> bool:
     """Probe whether the configured accelerator backend can initialise.
@@ -574,8 +602,6 @@ def _device_backend_usable(budget: Budget, reserve: float,
     (the time the device child + CPU fallback still need) runs out;
     ``attempts`` survives as an override cap for interactive use.
     """
-    import subprocess
-
     if os.environ.get("JAX_PLATFORMS", "") in ("cpu", ""):
         return True
     retry_sleep = float(os.environ.get("BENCH_CLAIM_RETRY_SLEEP", "60"))
@@ -585,20 +611,17 @@ def _device_backend_usable(budget: Budget, reserve: float,
             log(f"claim probe out of budget (remaining {budget.remaining():.0f}s, "
                 f"reserve {reserve:.0f}s) — surrendering to fallback")
             return False
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=probe_budget,
-                capture_output=True,
-            )
-            if proc.returncode == 0:
-                return True
-            log(f"device claim probe failed (attempt {attempt + 1}/{attempts}): "
-                f"{proc.stderr.decode(errors='replace')[-300:]}")
-        except subprocess.TimeoutExpired:
+        rc, _out, err, timed_out = _run_with_grace(
+            [sys.executable, "-c", "import jax; jax.devices()"], probe_budget
+        )
+        if timed_out:
             log(f"device claim probe timed out after {probe_budget:.0f}s "
                 f"(attempt {attempt + 1}/{attempts}) — claim may be wedged")
             continue  # the timeout already consumed the attempt's patience
+        if rc == 0:
+            return True
+        log(f"device claim probe failed (attempt {attempt + 1}/{attempts}): "
+            f"{err.decode(errors='replace')[-300:]}")
         # fast UNAVAILABLE errors would burn all attempts in seconds —
         # space them out so a recovering claim can still be caught, but
         # never sleep past the budget
@@ -611,8 +634,6 @@ def _run_tpu_child(env: dict, timeout_s: float) -> dict | None:
     """Run the device side (``--tpu-child``) in a subprocess with a hard
     watchdog; returns the child's result dict or None. The child claims
     the device, so the parent never imports jax and cannot wedge."""
-    import subprocess
-
     if timeout_s < 30:
         log(f"device bench child skipped: only {timeout_s:.0f}s left in budget")
         return None
@@ -624,29 +645,28 @@ def _run_tpu_child(env: dict, timeout_s: float) -> dict | None:
         except (ValueError, KeyError, IndexError):
             return None
 
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--tpu-child"],
-            timeout=timeout_s,
-            env=env,
-            capture_output=True,
-        )
-    except subprocess.TimeoutExpired as e:
-        sys.stderr.buffer.write(e.stderr or b"")
-        log(f"device bench child exceeded {timeout_s:.0f}s watchdog — killed")
-        # the child prints its PRIMARY line before the A/B tail: a kill
+    rc, stdout, stderr, timed_out = _run_with_grace(
+        [sys.executable, os.path.abspath(__file__), "--tpu-child"],
+        timeout_s,
+        env=env,
+    )
+    sys.stderr.buffer.write(stderr or b"")
+    if timed_out:
+        log(f"device bench child exceeded {timeout_s:.0f}s watchdog — "
+            "stopped (SIGTERM first: a mid-claim SIGKILL can wedge the "
+            "pool grant)")
+        # the child prints its PRIMARY line before the A/B tail: a stop
         # mid-A/B must not discard a completed measurement
-        res = parse_last(e.stdout or b"")
+        res = parse_last(stdout or b"")
         if res is not None:
             log("salvaged the child's pre-A/B primary line")
         return res
-    sys.stderr.buffer.write(proc.stderr)
-    if proc.returncode != 0:
-        log(f"device bench child failed (exit {proc.returncode})")
+    if rc != 0:
+        log(f"device bench child failed (exit {rc})")
         return None
-    res = parse_last(proc.stdout)
+    res = parse_last(stdout)
     if res is None:
-        log(f"device bench child printed no result: {proc.stdout[-300:]!r}")
+        log(f"device bench child printed no result: {stdout[-300:]!r}")
     return res
 
 
@@ -688,6 +708,11 @@ def _metric_name(fallback: bool) -> str:
 
 def main():
     if "--tpu-child" in sys.argv:
+        # SIGTERM → clean Python unwind (finalizers run, the device
+        # claim is released through normal teardown); the default
+        # handler would hard-kill the claim holder — the r4 wedge
+        signal.signal(signal.SIGTERM, lambda s, f: sys.exit(1))
+
         def emit_child_line(stats, sec_failed, alt=None):
             import jax
 

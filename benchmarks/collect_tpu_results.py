@@ -132,19 +132,39 @@ def main():
         out.append("layout A/B: fields absent (BENCH_AB=0 or pre-A/B artifact)")
 
     # the GROUP=32 dispatch-amortization probe (resume_tpu_matrix.sh):
-    # compare against the window's GROUP=16 north-star when present
-    g32 = _load(os.path.join(REPO, "benchmarks", "results", "group32_v2.json"))
+    # compare against the window's GROUP=16 north-star — but only a
+    # comparable one (same-window chip number): a ratio against a
+    # CPU-fallback or earlier-session artifact would read as promotion
+    # advice computed across different hardware or different windows
+    from benchmarks.artifact import artifact_status
+
+    g32_status, g32 = artifact_status(
+        os.path.join(REPO, "benchmarks", "results", "group32_v2.json"),
+        with_data=True,
+    )
     if g32 is not None and "error" not in g32 and g32.get("value"):
         line = (
             f"group32 probe: {g32['value']} merges/sec "
             f"(layout {g32.get('layout')}, group {g32.get('group', 32)})"
         )
-        if ns is not None and "error" not in ns and ns.get("value"):
+        if g32_status != "fresh":
+            line += "  (artifact from an EARLIER session)"
+        ns_comparable = (
+            g32_status == "fresh"
+            and ns is not None
+            and "error" not in ns
+            and ns.get("value")
+            and "cpu_fallback" not in ns.get("metric", "")
+            and not ns_stale
+        )
+        if ns_comparable:
             line += (
                 f" vs north-star {ns['value']} "
                 f"({g32['value'] / ns['value']:.2f}x) — promote BENCH_GROUP=32 "
                 "as the bench default if it wins on chip"
             )
+        else:
+            line += "  (no comparable same-window chip north-star for a ratio)"
         out.append(line)
 
     rows = []

@@ -15,20 +15,26 @@ say() { echo "[tpu-resume $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
 # success lines never do (same contract as run_tpu_matrix.sh)
 ok_line() { case "$1" in ""|*'"error"'*) return 1;; *) return 0;; esac; }
 
+# skip only artifacts FRESH within this round's window (12h), judged by
+# the emit() timestamp INSIDE the artifact (file mtimes reset on git
+# checkout): a committed artifact from an earlier session must not make
+# a future session silently re-present old rows as newly measured, and
+# a mid-run partial checkpoint must be re-run (it seeds the re-run via
+# load_partial). One shared predicate for harness rows AND bench.py
+# probes: benchmarks/artifact.py's artifact_status (common.py imports
+# it too; dependency-free — no jax import, so the gate can't block on
+# a wedged claim). A fresh FAILURE artifact (error field) re-runs.
+probe_fresh() { # artifact -> 0 iff fresh AND not a failure artifact
+  [ -f "$1" ] || return 1
+  [ "$(timeout 60 python -m benchmarks.artifact "$1" 2>/dev/null)" = "fresh" ] \
+    || return 1
+  ! grep -q '"error"' "$1"
+}
+
 run_row() { # name timeout module [env...]
   local name="$1" tmo="$2" mod="$3"; shift 3
-  # skip only artifacts FRESH within this round's window (12h), judged
-  # by the emit() timestamp INSIDE the artifact (file mtimes reset on
-  # git checkout): a committed artifact from an earlier session must not
-  # make a future session silently re-present old rows as newly
-  # measured, and a mid-run partial checkpoint must be re-run (it seeds
-  # the re-run via load_partial). One shared predicate:
-  # benchmarks/artifact.py's artifact_status (common.py imports it too).
-  # benchmarks/artifact.py is dependency-free (no jax import — the
-  # ambient axon boot would block the gate on a wedged claim)
   local art="benchmarks/results/${name}.tpu.json"
-  if [ -f "$art" ] && \
-     [ "$(timeout 60 python -m benchmarks.artifact "$art" 2>/dev/null)" = "fresh" ]; then
+  if probe_fresh "$art"; then
     say "$name: fresh artifact exists, skipping"
     return 0
   fi
@@ -82,21 +88,15 @@ else
   fi
 fi
 
-# shared bench.py probe runner: artifact-freshness skip gate (the
-# embedded-utc predicate run_row uses — a git-committed log marker
-# would survive a fresh checkout whose untracked artifact did not,
-# permanently skipping the probe), env-wrapped run, ok_line validation
-probe_fresh() { # outfile -> 0 iff fresh AND not a failure artifact
-  [ -f "$1" ] || return 1
-  [ "$(timeout 60 python -m benchmarks.artifact "$1" 2>/dev/null)" = "fresh" ] \
-    || return 1
-  ! grep -q '"error"' "$1"
-}
+# shared bench.py probe runner (same freshness gate as run_row).
+# Returns 0 only when the probe RAN and succeeded; 2 on fresh-skip —
+# callers with post-run actions (the scomp → north-star copy) must not
+# treat a skipped old artifact as this window's measurement.
 run_bench_probe() { # name timeout outfile [env...]
   local name="$1" tmo="$2" out="$3"; shift 3
   if probe_fresh "$out"; then
     say "$name: fresh artifact exists, skipping"
-    return 0
+    return 2
   fi
   say "$name: running (timeout ${tmo}s)"
   env "$@" timeout "$tmo" python bench.py > "$out" 2>>"$LOG"
