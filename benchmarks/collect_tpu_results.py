@@ -105,8 +105,16 @@ def main():
             )
 
     # the scomp A/B writes its own artifact (resume_tpu_matrix.sh):
-    # top_k-free compaction vs the top_k packed kernel
-    sc = _load(os.path.join(REPO, "benchmarks", "results", "scomp_ab.json"))
+    # top_k-free compaction vs the top_k packed kernel. Same freshness
+    # discipline as the group32 probe below: a prior window's copy must
+    # not masquerade as this one's verdict.
+    from benchmarks.artifact import artifact_status
+
+    sc_status, sc = artifact_status(
+        os.path.join(REPO, "benchmarks", "results", "scomp_ab.json"),
+        with_data=True,
+    )
+    sc_tag = "" if sc_status == "fresh" else "  (artifact from an EARLIER session)"
     if sc is not None and "error" not in sc:
         scp = sc.get("packed_scomp_merges_per_sec")
         tk = sc.get("packed_topk_merges_per_sec")
@@ -115,12 +123,12 @@ def main():
                 f"scomp A/B: packed_topk {tk} vs packed_scomp {scp} "
                 f"merges/sec ({scp / tk:.2f}x) — promote "
                 "merge_slice_packed_scomp to the bench default if the "
-                "top_k-free compaction wins on chip"
+                f"top_k-free compaction wins on chip{sc_tag}"
             )
         elif sc.get("value"):
             out.append(
                 f"scomp run: {sc.get('value')} merges/sec "
-                f"(layout {sc.get('layout')}, no in-run A/B fields)"
+                f"(layout {sc.get('layout')}, no in-run A/B fields){sc_tag}"
             )
     if (
         ns is not None
@@ -136,8 +144,6 @@ def main():
     # comparable one (same-window chip number): a ratio against a
     # CPU-fallback or earlier-session artifact would read as promotion
     # advice computed across different hardware or different windows
-    from benchmarks.artifact import artifact_status
-
     g32_status, g32 = artifact_status(
         os.path.join(REPO, "benchmarks", "results", "group32_v2.json"),
         with_data=True,

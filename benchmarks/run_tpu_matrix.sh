@@ -24,6 +24,21 @@ say() { echo "[tpu-matrix $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
 # FIELD NAME on an otherwise-successful line.
 ok_line() { case "$1" in ""|*'"error"'*) return 1;; *) return 0;; esac; }
 
+say "session start; devices probe:"
+timeout 120 python -c "import jax; print(jax.devices())" >>"$LOG" 2>&1 \
+  || { say "chip unreachable, aborting (don't burn the step timeouts)"; exit 1; }
+
+# Pallas verdict first — cheapest high-information probe in the window
+# (batched_roots_fn logs the Mosaic failure reason since round 5)
+say "pallas verdict probe (batched_roots_fn on the live chip)"
+timeout 600 python -c "
+from delta_crdt_ex_tpu.utils.devices import enable_compilation_cache
+enable_compilation_cache()
+from delta_crdt_ex_tpu.ops.pallas_tree import batched_roots_fn
+fn, tag = batched_roots_fn(16384)
+print('digest tree:', tag)
+" >>"$LOG" 2>&1 && say "pallas verdict done" || say "pallas verdict FAILED"
+
 say "smoke bench (validates kernels on chip, ~1 min when healthy)"
 SMOKE_LINE=$(BENCH_SMOKE=1 BENCH_TOTAL_BUDGET=800 BENCH_CLAIM_TIMEOUT=120 \
   BENCH_CLAIM_ATTEMPTS=2 BENCH_TPU_TIMEOUT=600 BENCH_NO_CPU_FALLBACK=1 \
@@ -32,37 +47,57 @@ echo "$SMOKE_LINE" >>"$LOG"
 ok_line "$SMOKE_LINE" || { say "smoke FAILED: $SMOKE_LINE"; exit 1; }
 say "smoke OK: $SMOKE_LINE"
 
-say "full north-star bench"
+say "full north-star bench (scomp primary + in-run top_k A/B since r5)"
 BENCH_TOTAL_BUDGET=2200 BENCH_CLAIM_TIMEOUT=120 BENCH_CLAIM_ATTEMPTS=2 \
 BENCH_TPU_TIMEOUT=2000 BENCH_NO_CPU_FALLBACK=1 \
   timeout 2400 python bench.py > /tmp/northstar.json 2>>"$LOG"
 NORTH_LINE=$(tail -1 /tmp/northstar.json 2>/dev/null)
 if ok_line "$NORTH_LINE"; then
   say "north-star: $NORTH_LINE"
+  # persist outside /tmp (container restarts wipe it) — this is also
+  # the scomp-vs-top_k decision artifact, so keep both names
+  cp /tmp/northstar.json benchmarks/results/northstar.tpu.json
+  cp /tmp/northstar.json benchmarks/results/scomp_ab.json
 else
   say "north-star FAILED: $NORTH_LINE (see $LOG)"
 fi
 
-# the north-star run above already A/Bs both merge layouts in-process
-# (BENCH_AB defaults on; the artifact line carries columns_/packed_
-# merges_per_sec and headlines the winner) — no second full run needed
+# the north-star run above already A/Bs scomp vs the top_k packed
+# kernel in-process (BENCH_AB and BENCH_SCOMP default on; the artifact
+# carries packed_scomp_/packed_topk_merges_per_sec and headlines the
+# winner) — no second full run needed
 case "$NORTH_LINE" in
-  *packed_merges_per_sec*) say "layout A/B captured in the north-star line";;
-  *) say "WARNING: north-star line has no layout A/B fields";;
+  *packed_topk_merges_per_sec*|*packed_scomp_merges_per_sec*)
+    say "kernel A/B captured in the north-star line";;
+  *) say "WARNING: north-star line has no in-run A/B fields";;
 esac
 
 say "merge-part probes (scatter/gather packing attribution)"
 timeout 1800 python -m benchmarks.profile_merge_parts >>"$LOG" 2>&1 \
   && say "profile_merge_parts done" || say "profile_merge_parts FAILED"
 
-# top_k-free compaction A/B (armed round 4; CPU full config ~1.9x)
-say "scomp A/B bench (top_k-free compaction vs top_k)"
-BENCH_SCOMP=1 BENCH_TOTAL_BUDGET=2200 BENCH_CLAIM_TIMEOUT=120 \
-BENCH_CLAIM_ATTEMPTS=2 BENCH_TPU_TIMEOUT=2000 BENCH_NO_CPU_FALLBACK=1 \
-  timeout 2400 python bench.py > benchmarks/results/scomp_ab.json 2>>"$LOG"
-SCOMP_LINE=$(tail -1 benchmarks/results/scomp_ab.json 2>/dev/null)
-ok_line "$SCOMP_LINE" && say "scomp A/B: $SCOMP_LINE" \
-  || say "scomp A/B FAILED: $SCOMP_LINE"
+say "scomp v2 phase attribution (donated-carry probes)"
+SCOMP_PARTS_NEIGHBOURS=16 timeout 900 python -m benchmarks.profile_scomp_parts >>"$LOG" 2>&1 \
+  && say "profile_scomp_parts done" || say "profile_scomp_parts FAILED"
+
+# GROUP=32 re-probe for scomp v2 (r4 rejected 32 for top_k — the
+# superlinear sort is gone; CPU is a wash, the chip decides). Lane
+# width left to the Poisson formula: a pinned 8 risks the stream
+# generator's honest overflow raise (~12%/run at lambda=1). Written
+# aside and promoted only on success so a failure can't truncate an
+# earlier session's artifact.
+say "group32 v2 probe (BENCH_GROUP=32)"
+BENCH_GROUP=32 BENCH_AB=0 BENCH_TOTAL_BUDGET=1500 \
+BENCH_CLAIM_TIMEOUT=120 BENCH_CLAIM_ATTEMPTS=2 BENCH_TPU_TIMEOUT=1300 \
+BENCH_NO_CPU_FALLBACK=1 \
+  timeout 1600 python bench.py > benchmarks/results/group32_v2.json.new 2>>"$LOG"
+G32_LINE=$(tail -1 benchmarks/results/group32_v2.json.new 2>/dev/null)
+if ok_line "$G32_LINE"; then
+  mv benchmarks/results/group32_v2.json.new benchmarks/results/group32_v2.json
+  say "group32 v2: $G32_LINE"
+else
+  say "group32 v2 FAILED: $G32_LINE (failure line kept at group32_v2.json.new)"
+fi
 
 say "harness matrix on TPU (runtime-driven; dispatch-bound, numbers are honest)"
 timeout 900 python -m benchmarks.ring_device >>"$LOG" 2>&1 \
