@@ -22,9 +22,17 @@ work per merge — a deliberately *favourable* cost model for the baseline
 overhead), so the reported ratio is conservative.
 
 Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": "merges/sec", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "merges/sec", "vs_baseline": N, ...}
+where ``value`` is the MEDIAN per-call-window rate (min/max and the
+aggregate ride alongside — see ``call_stats``), plus ``utc``/``group``
+provenance and, when the in-run A/B ran, both kernels' numbers.
 
-Env knobs: BENCH_SMOKE=1 shrinks sizes for CPU smoke runs.
+Env knobs: BENCH_SMOKE=1 shrinks sizes for CPU smoke runs;
+BENCH_PACKED/BENCH_SCOMP/BENCH_FUSED pick the merge kernel (scomp is
+the promoted default, the A/B tail times the top_k alternate);
+BENCH_GROUP/BENCH_BIN_WIDTH shape the delta grouping; BENCH_AB=0
+skips the alternate-kernel tail; BENCH_NO_CPU_FALLBACK=1 fails fast
+instead of emitting a labelled CPU number (interactive chip windows).
 
 Deadline contract: the whole run fits one wall-clock budget
 (``BENCH_TOTAL_BUDGET`` seconds, default 1380 — comfortably under a
