@@ -44,6 +44,7 @@ __all__ = [
     "MemoryStorage",
     "Replica",
     "Storage",
+    "WalLog",
     "child_spec",
     "mutate",
     "mutate_async",
@@ -66,6 +67,7 @@ _EXPORTS = {
     "FileStorage": ("delta_crdt_ex_tpu.runtime.storage", "FileStorage"),
     "Replica": ("delta_crdt_ex_tpu.runtime.replica", "Replica"),
     "Storage": ("delta_crdt_ex_tpu.runtime.storage", "Storage"),
+    "WalLog": ("delta_crdt_ex_tpu.runtime.wal", "WalLog"),
     "child_spec": ("delta_crdt_ex_tpu.api", "child_spec"),
     "mutate": ("delta_crdt_ex_tpu.api", "mutate"),
     "mutate_async": ("delta_crdt_ex_tpu.api", "mutate_async"),

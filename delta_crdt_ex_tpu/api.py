@@ -52,6 +52,25 @@ def start_link(crdt_module=AWLWWMap, *, threaded: bool = True, **opts) -> Replic
     thread (the GenServer-process analog). ``threaded=False`` leaves
     driving to the caller (deterministic tests / benches call
     ``sync_to_all()`` + ``transport.pump()``).
+
+    Durability (no reference analog — the reference writes the whole
+    replica image through storage on every change,
+    ``causal_crdt.ex:402-403``): pass ``wal_dir=<path>`` to switch
+    ``storage_mode="every_op"`` from O(state) snapshot writes to an
+    O(delta) write-ahead log (:mod:`delta_crdt_ex_tpu.runtime.wal`).
+    Knobs ride along to the replica:
+
+    - ``wal_dir`` — directory for segment files (and, when no
+      ``storage_module`` is given, compaction snapshots);
+    - ``fsync_mode`` — group-commit cadence: ``"record"`` | ``"batch"``
+      (default) | ``"interval"`` | ``"none"``;
+    - ``segment_bytes`` — roll to a new segment past this size;
+    - ``compact_every`` — checkpoint a snapshot and reclaim covered
+      segments after this many appended records.
+
+    Recovery is automatic: a restarted replica with the same ``name``
+    and ``wal_dir`` loads the newest snapshot and replays the log past
+    it (torn tail records are truncated, not crashed on).
     """
     opts.setdefault("sync_interval", DEFAULT_SYNC_INTERVAL)
     opts.setdefault("max_sync_size", DEFAULT_MAX_SYNC_SIZE)

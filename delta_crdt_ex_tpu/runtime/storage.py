@@ -57,6 +57,25 @@ def require_layout(tag, what: str) -> None:
         )
 
 
+def fsync_dir(directory: str) -> None:
+    """fsync a directory so entry creations/renames/unlinks inside it
+    survive power loss (fsyncing a file does NOT persist its dirent)."""
+    fd = os.open(directory, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def name_key(name: Any) -> str:
+    """Stable filesystem-safe key for a replica name (shared by
+    :class:`FileStorage` snapshot paths and the WAL's per-replica
+    segment directories, so the two stay colocatable)."""
+    import hashlib
+
+    return hashlib.blake2b(repr(name).encode(), digest_size=8).hexdigest()
+
+
 class Storage(Protocol):
     def write(self, name: Any, snapshot: Snapshot) -> None: ...
 
@@ -86,23 +105,40 @@ class MemoryStorage:
 
 
 class FileStorage:
-    """Directory-backed store: one pickle per replica name."""
+    """Directory-backed store: one pickle per replica name.
 
-    def __init__(self, directory: str):
+    ``fsync=True`` makes each write power-loss durable (file contents
+    fsynced before the rename, directory entry fsynced after) — required
+    when the snapshot is a WAL compaction checkpoint, because compaction
+    DELETES the fsynced log records the snapshot supersedes; an
+    unflushed snapshot there would trade durable records for page
+    cache. The default stays False: the plain ``every_op`` snapshot
+    path never promised machine-crash durability."""
+
+    def __init__(self, directory: str, *, fsync: bool = False):
         self.directory = directory
+        self.fsync = fsync
+        self._dir_synced = False  # own dirent persisted in the parent
         os.makedirs(directory, exist_ok=True)
 
     def _path(self, name) -> str:
-        import hashlib
-
-        h = hashlib.blake2b(repr(name).encode(), digest_size=8).hexdigest()
-        return os.path.join(self.directory, f"crdt_{h}.pkl")
+        return os.path.join(self.directory, f"crdt_{name_key(name)}.pkl")
 
     def write(self, name, snapshot: Snapshot) -> None:
         tmp = self._path(name) + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump(snapshot, f)
+            if self.fsync:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, self._path(name))
+        if self.fsync:
+            fsync_dir(self.directory)
+            if not self._dir_synced:
+                # a freshly created snapshot dir's own dirent must reach
+                # disk too, or power loss vanishes the directory whole
+                fsync_dir(os.path.dirname(self.directory) or ".")
+                self._dir_synced = True
 
     def read(self, name) -> Snapshot | None:
         try:

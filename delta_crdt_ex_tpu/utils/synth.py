@@ -114,6 +114,10 @@ def interval_delta_stream(
         keys = rng.integers(1, 1 << 63, size=delta_size, dtype=np.uint64)
         bucket = (keys & np.uint64(L - 1)).astype(np.int64)
         rows_u, inv = np.unique(bucket, return_inverse=True)
+        # the rows_sorted=True scatter vouch in __graft_entry__.py /
+        # bench.py rests on this strict ascent; a producer change that
+        # breaks it must fail loudly, not become a false XLA hint
+        assert (np.diff(rows_u) > 0).all(), "delta slice rows must strictly ascend"
         nrows = len(rows_u)
         cols = np.zeros(delta_size, np.int64)
         seen: dict[int, int] = {}

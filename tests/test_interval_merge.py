@@ -122,151 +122,3 @@ def test_interval_merge_is_idempotent():
     b.merge_slice(sl)
     assert b.read() == r1
     assert np.array_equal(np.asarray(b.state.leaf), leaf1)
-
-
-# ---------------------------------------------------------------------------
-# Property suite: random multi-writer interval streams vs the pyref oracle
-# (VERDICT r1 missing #5 — the wire-format analog of the reference's
-# aw_lww_map_property_test.exs:18-76 op-level property suite).
-
-from hypothesis import given, settings, strategies as st
-
-from delta_crdt_ex_tpu.utils.pyref import PyAWLWWMap
-
-BUCKET = 5
-WRITER_GIDS = (101, 202, 303)
-
-
-def _pow2(n, floor=1):
-    k = floor
-    while k < n:
-        k *= 2
-    return k
-
-
-def multi_slice(entries, lo, hi, gid):
-    """Single-writer single-bucket RowSlice, pow2-padded in S to bound
-    jit recompiles. ``entries`` = [(key, valh, ts, ctr)]; interval (lo, hi]."""
-    s = _pow2(max(len(entries), 1))
-    sl = dict(
-        rows=np.asarray([BUCKET], np.int32),
-        key=np.zeros((1, s), np.uint64),
-        valh=np.zeros((1, s), np.uint32),
-        ts=np.zeros((1, s), np.int64),
-        node=np.zeros((1, s), np.int32),
-        ctr=np.zeros((1, s), np.uint32),
-        alive=np.zeros((1, s), bool),
-        ctx_rows=np.asarray([[hi]], np.uint32),
-        ctx_lo=np.asarray([[lo]], np.uint32),
-        ctx_gid=np.array([gid], np.uint64),
-    )
-    for j, (key, valh, ts, ctr) in enumerate(entries):
-        sl["key"][0, j] = key
-        sl["valh"][0, j] = valh
-        sl["ts"][0, j] = ts
-        sl["ctr"][0, j] = ctr
-        sl["alive"][0, j] = True
-    return RowSlice(**{k: jnp.asarray(v) for k, v in sl.items()})
-
-
-@st.composite
-def interval_scenario(draw):
-    """Per-writer event timelines plus a randomly ordered message stream.
-
-    Each writer's timeline is a sequence of add/remove ops over a 4-key
-    space (all keys land in bucket ``BUCKET``). Messages are (writer,
-    T, lo, hi) delta-intervals snapshotted at timeline position T —
-    in-order, stale, duplicated, overlapping, empty (lo == hi), gapped
-    (lo above the receiver's horizon) and state-form (lo == 0) all arise
-    from the draw.
-    """
-    n_writers = draw(st.integers(1, 3))
-    timelines = []
-    for w in range(n_writers):
-        n_ev = draw(st.integers(0, 6))
-        evs = [
-            (draw(st.sampled_from(["add", "remove"])), draw(st.integers(0, 3)))
-            for _ in range(n_ev)
-        ]
-        timelines.append(evs)
-    msgs = []
-    for w, evs in enumerate(timelines):
-        n_msgs = draw(st.integers(0, 5))
-        for _ in range(n_msgs):
-            t = draw(st.integers(0, len(evs)))
-            minted = sum(1 for e in evs[:t] if e[0] == "add")
-            hi = draw(st.integers(0, minted))
-            lo = draw(st.integers(0, hi))
-            msgs.append((w, t, lo, hi))
-    msgs = draw(st.permutations(msgs)) if msgs else []
-    return timelines, msgs
-
-
-def _writer_history(w, evs):
-    """alive[t] = {ctr: (key, valh, ts)} after the first t events; ctr is
-    minted per add (1-based), ts unique across all writers."""
-    alive = {}
-    out = [dict(alive)]
-    ctr = 0
-    for i, (op, kidx) in enumerate(evs):
-        key = BUCKET + kidx * L
-        if op == "add":
-            ctr += 1
-            # remove-delta ⊔ add-delta: an add supersedes the key's old dots
-            alive = {c: e for c, e in alive.items() if e[0] != key}
-            alive[ctr] = (key, 1 + w * 100 + i, 1 + w * 1000 + i)
-        else:
-            alive = {c: e for c, e in alive.items() if e[0] != key}
-        out.append(dict(alive))
-    return out
-
-
-@settings(max_examples=200, deadline=None)
-@given(interval_scenario())
-def test_interval_streams_match_oracle(scenario):
-    timelines, msgs = scenario
-    histories = [_writer_history(w, evs) for w, evs in enumerate(timelines)]
-    b = BinnedKernelMap(11)
-    oracle = PyAWLWWMap()  # compressed (state-form) receiver context
-
-    def deliver(w, t, lo, hi):
-        gid = WRITER_GIDS[w]
-        snap = histories[w][t]
-        entries = [
-            (key, valh, ts, c) for c, (key, valh, ts) in sorted(snap.items()) if lo < c <= hi
-        ]
-        sl = multi_slice(entries, lo, hi, gid)
-        gap = hi > lo and oracle.dots.get(gid, 0) < lo
-        if gap:
-            with pytest.raises(ValueError, match="not contiguous"):
-                b.merge_slice(sl)
-            res = M.merge_slice(b.state, sl, kill_budget=4)
-            assert bool(res.need_ctx_gap) and not bool(res.ok)
-            return oracle  # receiver state unchanged
-        b.merge_slice(sl)
-        delta = PyAWLWWMap(
-            dots={(gid, c) for c in range(lo + 1, hi + 1)},
-            value={},
-            compressed=False,
-        )
-        for key, valh, ts, c in entries:
-            delta.value.setdefault(key, {})[(valh, ts)] = {(gid, c)}
-        keys = set(oracle.value) | set(delta.value)
-        return oracle.join(delta, keys)
-
-    for w, t, lo, hi in msgs:
-        oracle = deliver(w, t, lo, hi)
-        assert b.read() == oracle.read()
-        assert b.ctx() == {g: c for g, c in oracle.dots.items() if c}
-
-    # convergence: final full-state (lo=0) slice from every writer
-    for w, evs in enumerate(timelines):
-        minted = sum(1 for e in evs if e[0] == "add")
-        oracle = deliver(w, len(evs), 0, minted)
-    final = {}
-    for w, evs in enumerate(timelines):
-        for c, (key, valh, ts) in histories[w][len(evs)].items():
-            if key not in final or final[key][1] < ts:
-                final[key] = (valh, ts)
-    assert b.read() == {k: v for k, (v, _ts) in final.items()}
-    assert b.read() == oracle.read()

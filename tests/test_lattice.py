@@ -9,6 +9,7 @@ dict (SURVEY §4).
 import random
 
 import pytest
+pytest.importorskip("hypothesis")  # collection must degrade gracefully without it
 from hypothesis import given, settings, strategies as st
 
 from delta_crdt_ex_tpu.utils.pyref import PyAWLWWMap

@@ -7,6 +7,9 @@ overflow flags. Also pins ``pack``/``unpack`` as bitwise inverses.
 
 import numpy as np
 import jax.numpy as jnp
+import pytest
+
+pytest.importorskip("hypothesis")  # collection must degrade gracefully without it
 from hypothesis import given, settings
 from hypothesis import strategies as hyp_st
 

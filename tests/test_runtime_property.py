@@ -8,6 +8,9 @@ semantics coincide with sequential map semantics. Divergence-mode
 properties (partial sync, drops) live in ``test_simnet.py``.
 """
 
+import pytest
+
+pytest.importorskip("hypothesis")  # collection must degrade gracefully without it
 from hypothesis import given, settings, strategies as st
 
 from delta_crdt_ex_tpu import AWLWWMap

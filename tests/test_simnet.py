@@ -7,6 +7,9 @@ race detector (SURVEY §5.2): merge commutativity, idempotence, and
 retry-on-drop are each exercised by a fault class.
 """
 
+import pytest
+
+pytest.importorskip("hypothesis")  # collection must degrade gracefully without it
 from hypothesis import given, settings, strategies as st
 
 from delta_crdt_ex_tpu import AWLWWMap

@@ -308,6 +308,8 @@ def test_msgb_roundtrip_property():
     mixed-dtype/shape/contiguity numpy arrays, scalars) survives the
     arrays side-channel bit-identically."""
     import numpy as np
+
+    pytest.importorskip("hypothesis")
     from hypothesis import given, settings, strategies as st
 
     from delta_crdt_ex_tpu.runtime import tcp_transport as T
