@@ -107,6 +107,10 @@ def batched_roots_fn(num_leaves: int):
     tag = "xla"
     if num_leaves >= 128:
         try:
+            # the probe's entire job is to force execution once so a
+            # Mosaic lowering failure surfaces here, not in the sync
+            # walk; the production kernel path never syncs
+            # crdtlint: allow[host-sync] probe must synchronise by design
             jax.jit(batched_roots_pallas)(
                 jnp.zeros((2, num_leaves), jnp.uint32)
             ).block_until_ready()

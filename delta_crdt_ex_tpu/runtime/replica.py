@@ -1538,6 +1538,10 @@ class Replica:
 
     def ping(self) -> str:
         with self._lock:
+            # mailbox round-trip parity: a GenServer ``:ping`` call is
+            # served after every queued cast, so pending async mutations
+            # must be applied before the pong
+            self._flush()
             return "ok"
 
     # ------------------------------------------------------------------
@@ -1628,10 +1632,13 @@ class Replica:
                     # the reference's write-through-per-op (SURVEY §5.4)
                     self.checkpoint()
                     next_ckpt = now + self.checkpoint_interval
-                if self._wal is not None:
+                with self._lock:
                     # interval-fsync deferred syncs reach disk even when
-                    # the replica goes idle right after a commit
-                    with self._lock:
+                    # the replica goes idle right after a commit (the
+                    # None check sits under the lock too: WalLog is not
+                    # thread-safe by itself, and crash/stop close it
+                    # concurrently — crdtlint LOCK001)
+                    if self._wal is not None:
                         self._wal.maybe_sync()
                 self._wake.wait(timeout=max(0.0, min(next_sync - time.monotonic(), 0.05)))
                 self._wake.clear()
@@ -1654,10 +1661,14 @@ class Replica:
             self._wake.set()
             self._thread.join(timeout=5)
             self._thread = None
-        if self._wal is not None:
-            # a crash drops whatever the fsync cadence had not yet
-            # committed — the exact durability contract under test
-            self._wal.close(flush=False)
+        with self._lock:
+            # under the replica lock: WalLog is not thread-safe by
+            # itself, and a concurrent mutate() mid-append must not race
+            # the close (crdtlint LOCK001)
+            if self._wal is not None:
+                # a crash drops whatever the fsync cadence had not yet
+                # committed — the exact durability contract under test
+                self._wal.close(flush=False)
         self.transport.unregister(self.name)
 
     def stop(self) -> None:
@@ -1675,6 +1686,9 @@ class Replica:
             logger.debug("final sync on terminate failed", exc_info=True)
         if self.storage_mode == "interval" and self.storage_module is not None:
             self.checkpoint()
-        if self._wal is not None:
-            self._wal.close(flush=True)
+        with self._lock:
+            # same closing discipline as crash(): the WAL append path
+            # runs under this lock, so its close must too
+            if self._wal is not None:
+                self._wal.close(flush=True)
         self.transport.unregister(self.name)

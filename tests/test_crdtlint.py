@@ -1,0 +1,894 @@
+"""crdtlint: golden fixtures per rule family + end-to-end over the real
+package.
+
+The fixture tests build throwaway mini-packages on disk and assert each
+rule family fires on its positive snippet and stays silent on the
+negative one. The end-to-end tests run the real CLI over
+``delta_crdt_ex_tpu`` (must be clean: zero unsuppressed findings) and —
+via the engine's source overlay — re-lint mutated copies of real
+modules to prove the pass actually *detects* the bug classes it claims
+to (every ``with self._lock:`` deletion in replica.py, an unannotated
+``.item()`` in ops/join.py), not just that the tree happens to be
+quiet.
+
+Pure-stdlib under test: no jax/numpy import happens in the linter, so
+these tests are cheap enough for tier-1.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from tools.crdtlint.engine import (  # noqa: E402
+    Finding,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+
+PKG = "delta_crdt_ex_tpu"
+
+
+def make_pkg(root: Path, modules: dict[str, str]) -> Path:
+    """Write a mini-package; keys are slash paths under the package dir
+    (e.g. "ops/kern.py"), values module source."""
+    pkg = root / "fixpkg"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    for rel, src in modules.items():
+        path = pkg / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        init = path.parent / "__init__.py"
+        if not init.exists():
+            init.write_text("")
+        path.write_text(textwrap.dedent(src))
+    return pkg
+
+
+def lint(pkg: Path, **kw) -> list[Finding]:
+    new, _baselined, _allowed = run_lint([pkg], **kw)
+    return new
+
+
+def rules_of(findings: list[Finding]) -> set[str]:
+    return {f.rule for f in findings}
+
+
+# ----------------------------------------------------------------------
+# LOCK001 — lock discipline
+
+
+LOCKED_CLASS = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+            self._stop = threading.Event()
+
+        def put(self, x):
+            with self._lock:
+                self._items.append(x)
+
+        def {body}
+"""
+
+
+def test_lock_unguarded_public_read_flagged(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {"box.py": LOCKED_CLASS.format(body="size(self):\n            return len(self._items)")},
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"LOCK001"}
+    assert "_items" in found[0].message and "size" in found[0].message
+
+
+def test_lock_guarded_access_clean(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": LOCKED_CLASS.format(
+                body=(
+                    "size(self):\n"
+                    "            with self._lock:\n"
+                    "                return len(self._items)"
+                )
+            )
+        },
+    )
+    assert lint(pkg) == []
+
+
+_HELPER_CLASS = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def put(self, x):
+            with self._lock:
+                self._put(x)
+
+        def _put(self, x):
+            self._items.append(x)
+"""
+
+
+def test_lock_private_helper_inherits_caller_lock(tmp_path):
+    # a private helper called only under the lock is clean; the same
+    # helper reached from a lock-free public path is flagged
+    pkg = make_pkg(tmp_path, {"box.py": _HELPER_CLASS})
+    assert lint(pkg) == []
+
+    dirty = _HELPER_CLASS + (
+        "\n"
+        "        def put_fast(self, x):\n"
+        "            self._put(x)\n"
+    )
+    pkg2 = make_pkg(tmp_path / "b", {"box.py": dirty})
+    found = lint(pkg2)
+    assert rules_of(found) == {"LOCK001"}
+
+
+def test_lock_thread_entry_flagged(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+                    self._thread = threading.Thread(target=self._loop)
+
+                def bump(self):
+                    with self._lock:
+                        self._n += 1
+
+                def _loop(self):
+                    while True:
+                        print(self._n)
+            """
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"LOCK001"}
+    assert "_loop" in found[0].message
+
+
+def test_lock_acquire_wrapper_recognised(tmp_path):
+    # Replica's _acquire idiom: helper acquires, caller releases
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                    self._items = []
+
+                def _acquire(self):
+                    if not self._lock.acquire(timeout=1):
+                        raise TimeoutError
+
+                def put(self, x):
+                    self._acquire()
+                    try:
+                        self._items.append(x)
+                    finally:
+                        self._lock.release()
+            """
+        },
+    )
+    assert lint(pkg) == []
+
+
+def test_lock_threadsafe_attrs_exempt(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": """
+            import queue
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+                    self._wake = threading.Event()
+                    self._data = {}
+
+                def put(self, x):
+                    with self._lock:
+                        self._data[x] = x
+                        self._q.put(x)
+
+                def poke(self):
+                    self._q.put_nowait(None)
+                    self._wake.set()
+            """
+        },
+    )
+    assert lint(pkg) == []
+
+
+def test_lock_init_does_not_mint_guards(tmp_path):
+    # attributes only ever written in __init__ are pre-publication state
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._name = "box"
+                    self._items = []
+
+                def put(self, x):
+                    with self._lock:
+                        self._items.append(x)
+
+                def name(self):
+                    return self._name
+            """
+        },
+    )
+    assert lint(pkg) == []
+
+
+# ----------------------------------------------------------------------
+# SYNC001 / SYNC002 — host-sync leaks
+
+
+def test_sync_item_in_jit_reachable_cross_module(tmp_path):
+    # entry registered in one module, offending body in another: the
+    # rule must walk the import graph, not the file it found jit() in
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/kern.py": """
+            def combine(x, y):
+                return x + y
+
+            def fold(x):
+                bad = combine(x, x).item()
+                return bad
+            """,
+            "models/model.py": """
+            import jax
+
+            from fixpkg.ops import kern
+
+            jit_fold = jax.jit(kern.fold)
+            """,
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"SYNC001"}
+    assert found[0].path.endswith("ops/kern.py")
+
+
+def test_sync_unreachable_function_not_flagged(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/kern.py": """
+            def helper(x):
+                return x.tolist()
+            """,
+        },
+    )
+    assert lint(pkg) == []
+
+
+def test_sync_int_coercion_flagged_static_shape_exempt(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/kern.py": """
+            import jax
+
+            @jax.jit
+            def fold(x):
+                n = int(x.shape[0])      # static: fine
+                v = int(x.sum())         # traced: host sync
+                return n + v
+            """,
+        },
+    )
+    found = lint(pkg)
+    assert len(found) == 1 and found[0].rule == "SYNC001"
+    assert "int()" in found[0].message
+
+
+def test_sync_np_asarray_and_decorated_partial_jit(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "parallel/mesh.py": """
+            from functools import partial
+
+            import jax
+            import numpy as np
+
+            @partial(jax.jit, static_argnames=("k",))
+            def step(x, k=1):
+                return np.asarray(x) + k
+            """,
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"SYNC001"}
+    assert "np.asarray" in found[0].message
+
+
+def test_sync_shard_map_body_reached_via_nested_def(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "parallel/mesh.py": """
+            import jax
+            from jax import shard_map
+
+            @jax.jit
+            def gossip(x):
+                def step(local):
+                    return local.block_until_ready()
+                return shard_map(step, mesh=None, in_specs=None, out_specs=None)(x)
+            """,
+        },
+    )
+    assert "SYNC001" in rules_of(lint(pkg))
+
+
+def test_sync_block_until_ready_in_op_module_flagged(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/kern.py": """
+            import jax
+
+            def probe(f, x):
+                jax.jit(f)(x).block_until_ready()
+                return f
+            """,
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"SYNC002"}
+
+
+def test_sync_allow_comment_suppresses(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/kern.py": """
+            import jax
+
+            def probe(f, x):
+                # crdtlint: allow[host-sync] probe must synchronise by design
+                jax.jit(f)(x).block_until_ready()
+                return f
+            """,
+        },
+    )
+    new, _baselined, allowed = run_lint([pkg])
+    assert new == [] and len(allowed) == 1
+
+
+def test_sync_allow_comment_does_not_bleed_to_next_line(tmp_path):
+    # a trailing allow on line N must not suppress a finding on N+1
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/kern.py": """
+            import jax
+
+            def probe(f, x):
+                a = jax.jit(f)(x).block_until_ready()  # crdtlint: allow[host-sync] why
+                b = jax.jit(f)(x).block_until_ready()
+                return a, b
+            """,
+        },
+    )
+    new, _baselined, allowed = run_lint([pkg])
+    assert len(allowed) == 1 and len(new) == 1
+    assert new[0].rule == "SYNC002"
+
+
+def test_lock_reentrant_with_does_not_release_outer_hold(tmp_path):
+    # RLock reentrancy: an inner `with self._lock:` exiting must not make
+    # the rest of the outer critical section look unguarded
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                    self._items = []
+
+                def put(self, x):
+                    with self._lock:
+                        with self._lock:
+                            self._items.append(x)
+                        self._items.append(x)
+            """
+        },
+    )
+    assert lint(pkg) == []
+
+
+def test_sync_block_until_ready_outside_op_modules_ignored(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "runtime/driver.py": """
+            import jax
+
+            def hibernate(state):
+                jax.block_until_ready(state)
+            """,
+        },
+    )
+    assert lint(pkg) == []
+
+
+# ----------------------------------------------------------------------
+# PURE001–PURE003 — lattice-op purity
+
+
+def test_purity_arg_mutation_flagged(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/join2.py": """
+            def join(local, remote):
+                local.ctx = remote.ctx
+                return local
+            """,
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"PURE001"}
+
+
+def test_purity_mutator_call_flagged_at_indexer_exempt(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "models/m.py": """
+            def merge_contexts(a, b):
+                out = a.at[0].set(b[0])   # functional jax update: fine
+                a.update(b)               # in-place: flagged
+                return out
+            """,
+        },
+    )
+    found = lint(pkg)
+    assert len(found) == 1 and found[0].rule == "PURE001"
+    assert "update" in found[0].message
+
+
+def test_purity_impure_calls_and_global(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/j.py": """
+            import random
+            import time
+
+            _CACHE = {}
+
+            def delta_of(state):
+                global _CACHE
+                _CACHE = {}
+                return state
+
+            def merge(a, b):
+                if random.random() < 0.5:
+                    return a
+                return b, time.time()
+            """,
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"PURE002", "PURE003"}
+    assert sum(f.rule == "PURE003" for f in found) == 2
+
+
+def test_purity_scope_limited_to_ops_models(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "runtime/r.py": """
+            import time
+
+            def merge(a, b):
+                a.x = time.time()
+                return a
+            """,
+        },
+    )
+    assert lint(pkg) == []
+
+
+def test_purity_nonmatching_names_ignored(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/k.py": """
+            import time
+
+            def stamp(a):
+                return time.time()
+            """,
+        },
+    )
+    assert lint(pkg) == []
+
+
+# ----------------------------------------------------------------------
+# DONATE001 — donation hygiene
+
+
+def test_donation_reuse_flagged(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/k.py": """
+            import jax
+
+            def grow(state):
+                return state
+
+            jit_grow = jax.jit(grow, donate_argnums=(0,))
+
+            def driver(state):
+                out = jit_grow(state)
+                return out, state.shape
+            """,
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"DONATE001"}
+    assert "'state'" in found[0].message
+
+
+def test_donation_rebind_clean(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/k.py": """
+            import jax
+
+            def grow(state):
+                return state
+
+            jit_grow = jax.jit(grow, donate_argnums=(0,))
+
+            def driver(state):
+                state = jit_grow(state)
+                return state
+            """,
+        },
+    )
+    assert lint(pkg) == []
+
+
+def test_donation_cross_module_call_site(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/k.py": """
+            import jax
+
+            def grow(state):
+                return state
+
+            jit_grow = jax.jit(grow, donate_argnums=(0,))
+            """,
+            "runtime/r.py": """
+            from fixpkg.ops.k import jit_grow
+
+            def driver(state):
+                out = jit_grow(state)
+                return out, state
+            """,
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"DONATE001"}
+    assert found[0].path.endswith("runtime/r.py")
+
+
+def test_lock_conditional_acquire_does_not_leak_held_state(tmp_path):
+    # a lock acquired in only one branch is NOT held after the join
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+
+                def put(self, x):
+                    with self._lock:
+                        self._items.append(x)
+
+                def maybe(self, cond, x):
+                    if cond:
+                        self._lock.acquire()
+                    self._items.append(x)
+                    if cond:
+                        self._lock.release()
+            """
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"LOCK001"}
+
+
+def test_sync_similar_name_helper_not_flagged(tmp_path):
+    # SYNC002 must match the exact name, not a substring
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/k.py": """
+            def safe_block_until_ready(x):
+                return x
+
+            def driver(x):
+                return safe_block_until_ready(x)
+            """,
+        },
+    )
+    assert lint(pkg) == []
+
+
+def test_donation_early_return_branch_not_flagged(tmp_path):
+    # `return state` only runs when the donating branch was NOT taken
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/k.py": """
+            import jax
+
+            def grow(state):
+                return state
+
+            jit_grow = jax.jit(grow, donate_argnums=(0,))
+
+            def driver(state, flag):
+                if flag:
+                    out = jit_grow(state)
+                    return out
+                return state
+            """,
+        },
+    )
+    assert lint(pkg) == []
+
+
+def test_cli_select_rejects_unknown_rule(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.crdtlint", PKG, "--select", "SYNC01"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2 and "unknown rule" in proc.stderr
+
+
+def test_donation_multiline_call_not_flagged(tmp_path):
+    # the donor's own Name node on a continuation line is the donation
+    # itself, not a read after the call
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/k.py": """
+            import jax
+
+            def grow(state):
+                return state
+
+            jit_grow = jax.jit(grow, donate_argnums=(0,))
+
+            def driver(state):
+                out = jit_grow(
+                    state,
+                )
+                return out
+            """,
+        },
+    )
+    assert lint(pkg) == []
+
+
+def test_sync_same_name_host_function_not_flagged(tmp_path):
+    # reachability is keyed by node identity: an untraced host-side
+    # function sharing a jit entry's name must not be flagged
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/k.py": """
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                return x + 1
+
+            class HostProbe:
+                def kernel(self, x):
+                    return x.item()
+            """,
+        },
+    )
+    assert lint(pkg) == []
+
+
+# ----------------------------------------------------------------------
+# baseline workflow
+
+
+def test_baseline_roundtrip_and_count_semantics(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {"box.py": LOCKED_CLASS.format(body="size(self):\n            return len(self._items)")},
+    )
+    found = lint(pkg)
+    assert len(found) == 1
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(bl_path, found)
+    data = json.loads(bl_path.read_text())
+    assert data["version"] == 1 and len(data["findings"]) == 1
+
+    # baselined finding no longer reported as new
+    new, baselined, _ = run_lint([pkg], baseline=load_baseline(bl_path))
+    assert new == [] and len(baselined) == 1
+
+    # a second finding site: the baseline absorbs only what it records
+    # (the size() fingerprint); the new peek() site is reported as new
+    extra = LOCKED_CLASS.format(
+        body=(
+            "size(self):\n"
+            "            return len(self._items)\n\n"
+            "        def peek(self):\n"
+            "            return len(self._items)"
+        )
+    )
+    pkg2 = make_pkg(tmp_path / "b", {"box.py": extra})
+    new2, baselined2, _ = run_lint([pkg2], baseline=load_baseline(bl_path))
+    assert len(new2) + len(baselined2) == 2 and len(baselined2) <= 1
+
+
+def test_write_baseline_with_select_preserves_other_rules(tmp_path):
+    # selective rewrite must carry over accepted debt of unselected rules
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/k.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+
+                def put(self, x):
+                    with self._lock:
+                        self._items.append(x)
+
+                def size(self):
+                    return len(self._items)
+
+            def merge(a, b):
+                a.update(b)
+                return a
+            """,
+        },
+    )
+    bl = tmp_path / "bl.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.crdtlint", str(pkg),
+         "--baseline", str(bl), "--write-baseline"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    full = load_baseline(bl)
+    assert {r for (_p, r, _m) in full} == {"LOCK001", "PURE001"}
+    # selective rewrite of just PURE001 must not drop the LOCK001 entry
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.crdtlint", str(pkg),
+         "--baseline", str(bl), "--select", "PURE001", "--write-baseline"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert {r for (_p, r, _m) in load_baseline(bl)} == {"LOCK001", "PURE001"}
+
+
+# ----------------------------------------------------------------------
+# end-to-end over the real package
+
+
+def _cli(*argv: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "tools.crdtlint", *argv],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+def test_e2e_package_is_clean():
+    """The tier-1 gate: zero unsuppressed findings on the real tree."""
+    proc = _cli(PKG)
+    assert proc.returncode == 0, f"crdtlint found:\n{proc.stdout}{proc.stderr}"
+    assert "0 finding(s)" in proc.stdout
+
+
+def test_e2e_list_rules_and_bad_package():
+    assert "LOCK001" in _cli("--list-rules").stdout
+    assert _cli("no_such_pkg").returncode == 2
+
+
+def test_e2e_every_lock_deletion_in_replica_is_caught():
+    """Acceptance: deleting any single ``with self._lock:`` from
+    runtime/replica.py must produce a finding."""
+    rel = f"{PKG}/runtime/replica.py"
+    src = (REPO_ROOT / rel).read_text()
+    lines = src.splitlines(keepends=True)
+    sites = [i for i, l in enumerate(lines) if l.strip() == "with self._lock:"]
+    assert len(sites) >= 10, "replica.py lost its lock regions?"
+    for site in sites:
+        mutated = lines[:]
+        indent = len(lines[site]) - len(lines[site].lstrip())
+        mutated[site] = " " * indent + "if True:\n"
+        new, _, _ = run_lint(
+            [REPO_ROOT / PKG], overlay={rel: "".join(mutated)}
+        )
+        assert any(f.rule == "LOCK001" for f in new), (
+            f"deleting the lock at replica.py:{site + 1} went undetected"
+        )
+
+
+def test_e2e_unannotated_item_in_join_is_caught():
+    """Acceptance: an unannotated .item() in ops/join.py must fail."""
+    rel = f"{PKG}/ops/join.py"
+    src = (REPO_ROOT / rel).read_text()
+    anchor = "    n_killed = jnp.sum((local.alive & ~alive1).astype(jnp.int32))"
+    assert anchor in src
+    mutated = src.replace(anchor, anchor + "\n    _dbg = n_killed.item()")
+    new, _, _ = run_lint([REPO_ROOT / PKG], overlay={rel: mutated})
+    assert any(
+        f.rule == "SYNC001" and f.path.endswith("ops/join.py") for f in new
+    )
+
+
+def test_e2e_real_tree_clean_via_engine():
+    new, _baselined, allowed = run_lint([REPO_ROOT / PKG])
+    assert new == []
+    # the pallas probe carries exactly one justified allow
+    assert any(f.path.endswith("ops/pallas_tree.py") for f in allowed)

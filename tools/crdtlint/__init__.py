@@ -1,0 +1,30 @@
+"""crdtlint — project-specific static analysis for the delta-CRDT runtime.
+
+Four rule families over the package's real import graph (no hardcoded
+file lists):
+
+- ``LOCK001``   lock discipline: accesses to lock-guarded ``self._*``
+  attributes on public or thread-entry paths that can run without the
+  guarding lock held;
+- ``SYNC001``/``SYNC002`` JAX host-sync leaks: ``.item()``,
+  ``.tolist()``, ``int()``/``float()`` coercion, ``np.asarray`` and
+  ``block_until_ready()`` inside functions reachable from a
+  ``jax.jit``/``shard_map``/``pallas_call`` entry point (SYNC001), and
+  ``block_until_ready()`` anywhere in op-library modules (SYNC002 —
+  sync belongs to the caller/bench harness, not the op body);
+- ``PURE001``–``PURE003`` lattice-op purity: ``join``/``merge``/
+  ``delta`` functions in ``ops/``/``models/`` must not mutate argument
+  pytrees, write module globals, or call ``time.*``/``random.*``;
+- ``DONATE001`` donation hygiene: ``donate_argnums``/``donate_argnames``
+  arguments re-read after the jitted call.
+
+Suppression: an inline ``# crdtlint: allow[<tag>] <why>`` comment on the
+flagged line (or the line directly above) — tags are ``lock``,
+``host-sync``, ``purity``, ``donation``, an exact rule id, or ``all`` —
+or a checked-in baseline (``--baseline`` / ``--write-baseline``) that
+records pre-existing findings by (path, rule, message) fingerprint.
+"""
+
+from tools.crdtlint.engine import Finding, Project, load_baseline, run_lint
+
+__all__ = ["Finding", "Project", "load_baseline", "run_lint"]

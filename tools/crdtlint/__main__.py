@@ -1,0 +1,5 @@
+import sys
+
+from tools.crdtlint.cli import main
+
+sys.exit(main())

@@ -1,0 +1,161 @@
+"""crdtlint command line.
+
+Usage::
+
+    python -m tools.crdtlint delta_crdt_ex_tpu            # lint, exit 1 on findings
+    python -m tools.crdtlint delta_crdt_ex_tpu --write-baseline
+    python -m tools.crdtlint delta_crdt_ex_tpu --baseline path.json
+    python -m tools.crdtlint --list-rules
+
+Exit codes: 0 clean (or fully suppressed), 1 unsuppressed findings,
+2 usage/configuration error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tools.crdtlint.engine import Finding, load_baseline, run_lint, write_baseline
+
+#: anchored beside this module, not the CWD: the installed ``crdtlint``
+#: script must find the checked-in baseline from any working directory
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+RULE_CATALOG = [
+    ("LOCK001", "access to a lock-guarded self._* attribute on a path that can "
+                "run without the guarding lock held"),
+    ("SYNC001", ".item()/.tolist()/int()/float()/np.asarray/device_get/"
+                "block_until_ready inside a function reachable from a "
+                "jax.jit / shard_map / pallas_call entry point"),
+    ("SYNC002", "block_until_ready() in an op-library module (ops/, parallel/) "
+                "— synchronisation belongs to the caller/bench harness"),
+    ("PURE001", "join/merge/delta op mutates an argument pytree in place"),
+    ("PURE002", "join/merge/delta op declares a module global"),
+    ("PURE003", "join/merge/delta op calls time.*/random.*/secrets.* — "
+                "nondeterministic joins diverge replica-to-replica"),
+    ("DONATE001", "argument donated via donate_argnums/donate_argnames is read "
+                  "again after the jitted call"),
+]
+
+
+def main(argv: list[str] | None = None) -> int:
+    try:
+        return _main(argv)
+    except BrokenPipeError:
+        # stdout went away mid-report (e.g. `crdtlint ... | head`): the
+        # consumer saw a truncated report, so a gate must NOT read this
+        # as clean — fail conservatively instead of crashing
+        return 1
+
+
+def _main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="crdtlint",
+        description="AST-based static analysis for the delta-CRDT TPU runtime: "
+        "lock discipline, JAX host-sync leaks, lattice-op purity, "
+        "donation hygiene.",
+    )
+    parser.add_argument(
+        "packages", nargs="*",
+        help="package directories to lint (e.g. delta_crdt_ex_tpu)",
+    )
+    parser.add_argument(
+        "--baseline", type=Path, default=None,
+        help=f"baseline file of accepted pre-existing findings "
+        f"(default: {DEFAULT_BASELINE} when it exists)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore any baseline file; report every finding",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="record current unsuppressed findings into the baseline file "
+        "and exit 0",
+    )
+    parser.add_argument(
+        "--select", action="append", default=None, metavar="RULE",
+        help="only run the given rule id(s) (repeatable)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    parser.add_argument(
+        "-q", "--quiet", action="store_true",
+        help="suppress the summary line (findings only)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in RULE_CATALOG:
+            print(f"{rule:10s} {desc}")
+        return 0
+
+    if not args.packages:
+        parser.error("at least one package directory is required")
+
+    package_dirs: list[Path] = []
+    for pkg in args.packages:
+        p = Path(pkg)
+        if not p.is_dir() or not (p / "__init__.py").exists():
+            print(f"crdtlint: {pkg!r} is not a package directory", file=sys.stderr)
+            return 2
+        package_dirs.append(p)
+
+    baseline_path = args.baseline or DEFAULT_BASELINE
+    baseline = None
+    if not args.no_baseline and not args.write_baseline and baseline_path.exists():
+        try:
+            baseline = load_baseline(baseline_path)
+        except (ValueError, KeyError) as e:
+            print(f"crdtlint: bad baseline {baseline_path}: {e}", file=sys.stderr)
+            return 2
+
+    select = set(args.select) if args.select else None
+    if select:
+        known = {rule for rule, _desc in RULE_CATALOG}
+        bad = select - known
+        if bad:
+            # a typo'd selection must not turn the gate vacuously green
+            print(
+                f"crdtlint: unknown rule id(s) {sorted(bad)}; "
+                f"known: {sorted(known)}",
+                file=sys.stderr,
+            )
+            return 2
+    new, baselined, allowed = run_lint(
+        package_dirs, baseline=baseline, select=select
+    )
+
+    if args.write_baseline:
+        entries = list(new)
+        if select and baseline_path.exists():
+            # a selective rewrite must not discard other rules' accepted
+            # debt: carry over every baselined entry outside the selection
+            kept = load_baseline(baseline_path)
+            for (path, rule, message), count in kept.items():
+                if rule not in select:
+                    entries.extend(
+                        Finding(path, 0, rule, message) for _ in range(count)
+                    )
+        write_baseline(baseline_path, entries)
+        print(
+            f"crdtlint: wrote {len(entries)} finding(s) to {baseline_path} "
+            f"({len(allowed)} allow-commented occurrences left inline)"
+        )
+        return 0
+
+    for f in new:
+        print(f.render())
+    if not args.quiet:
+        print(
+            f"crdtlint: {len(new)} finding(s) "
+            f"({len(allowed)} allowed inline, {len(baselined)} baselined)"
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

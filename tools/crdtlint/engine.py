@@ -1,0 +1,313 @@
+"""crdtlint engine: module loading, import graph, findings, baseline.
+
+Everything here is stdlib-only (``ast`` + ``json``): the linter must be
+runnable in environments where the package's own dependencies (jax,
+numpy) are absent or expensive to import, and must never execute the
+code it analyses.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Iterable
+
+#: allow-comment grammar: ``# crdtlint: allow[tag,tag2] optional why``.
+#: The justification text after the bracket is free-form but encouraged
+#: (ARCHITECTURE.md documents the convention: every allow states a why).
+_ALLOW_RE = re.compile(r"#\s*crdtlint:\s*allow\[([A-Za-z0-9_\-, ]+)\]")
+
+#: rule-family tag -> rule-id prefix (an exact rule id or ``all`` also work)
+FAMILY_TAGS = {
+    "lock": "LOCK",
+    "host-sync": "SYNC",
+    "purity": "PURE",
+    "donation": "DONATE",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str  # posix path relative to the lint root
+    line: int
+    rule: str  # e.g. "LOCK001"
+    message: str
+
+    def fingerprint(self) -> tuple[str, str, str]:
+        """Baseline identity: line numbers drift on unrelated edits, so
+        the fingerprint is (path, rule, message) with a per-fingerprint
+        count in the baseline file."""
+        return (self.path, self.rule, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} {self.rule} {self.message}"
+
+
+class ModuleInfo:
+    """One parsed module: AST, raw source lines, allow-comment map, and
+    the import table used to resolve cross-module references."""
+
+    def __init__(self, name: str, path: Path, rel: str, source: str):
+        self.name = name  # dotted module name, e.g. pkg.ops.join
+        self.path = path
+        self.rel = rel  # posix relpath used in findings/baseline
+        self.source = source
+        self.tree = ast.parse(source, filename=str(path))
+        self.lines = source.splitlines()
+        self.allows = self._scan_allows(self.lines)
+        # alias -> ("mod", modname) | ("sym", modname, symbol)
+        self.imports: dict[str, tuple] = {}
+        # top-level function/async defs by name (methods live under classes)
+        self.functions: dict[str, ast.FunctionDef] = {}
+        self.classes: dict[str, ast.ClassDef] = {}
+
+    @staticmethod
+    def _scan_allows(lines: list[str]) -> dict[int, set[str]]:
+        allows: dict[int, set[str]] = {}
+        for i, raw in enumerate(lines, start=1):
+            m = _ALLOW_RE.search(raw)
+            if not m:
+                continue
+            tags = {t.strip() for t in m.group(1).split(",") if t.strip()}
+            allows.setdefault(i, set()).update(tags)
+            # a pure-comment line annotates the next source line
+            if raw.lstrip().startswith("#"):
+                allows.setdefault(i + 1, set()).update(tags)
+        return allows
+
+    def allowed(self, line: int, rule: str) -> bool:
+        # only this line's tags: trailing comments register on their own
+        # line, comment-only lines were projected onto the next line at
+        # scan time — so an allow can never bleed onto a neighbouring
+        # statement's findings
+        tags = self.allows.get(line)
+        if not tags:
+            return False
+        if "all" in tags or rule in tags:
+            return True
+        return any(
+            (prefix := FAMILY_TAGS.get(tag)) and rule.startswith(prefix)
+            for tag in tags
+        )
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """`a.b.c` attribute chain -> "a.b.c" (None when not a plain chain)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class Project:
+    """All modules of one target package plus the import graph among
+    them.
+
+    ``overlay`` maps relative posix paths to replacement source text —
+    used by the test suite to re-lint a mutated copy of a real module
+    without touching the working tree.
+    """
+
+    def __init__(self, package_dir: Path, root: Path | None = None,
+                 overlay: dict[str, str] | None = None):
+        package_dir = package_dir.resolve()
+        if not (package_dir / "__init__.py").exists():
+            raise ValueError(f"{package_dir} is not a package (no __init__.py)")
+        self.package_dir = package_dir
+        self.package_name = package_dir.name
+        self.root = (root or package_dir.parent).resolve()
+        overlay = overlay or {}
+        self.modules: dict[str, ModuleInfo] = {}
+        for path in sorted(package_dir.rglob("*.py")):
+            rel = path.relative_to(self.root).as_posix()
+            parts = path.relative_to(package_dir.parent).with_suffix("").parts
+            if parts[-1] == "__init__":
+                parts = parts[:-1]
+            name = ".".join(parts)
+            source = overlay.get(rel)
+            if source is None:
+                source = path.read_text(encoding="utf-8")
+            self.modules[name] = ModuleInfo(name, path, rel, source)
+        for mod in self.modules.values():
+            self._index_module(mod)
+
+    # -- indexing ------------------------------------------------------
+
+    def _index_module(self, mod: ModuleInfo) -> None:
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mod.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                mod.classes[node.name] = node
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    target = alias.name
+                    if self._in_project(target):
+                        mod.imports[alias.asname or target.split(".")[0]] = (
+                            ("mod", target)
+                            if alias.asname
+                            else ("modroot", target.split(".")[0])
+                        )
+            elif isinstance(node, ast.ImportFrom):
+                base = self._resolve_from(mod, node)
+                if base is None:
+                    continue
+                for alias in node.names:
+                    full = f"{base}.{alias.name}"
+                    key = alias.asname or alias.name
+                    if full in self.modules:
+                        mod.imports[key] = ("mod", full)
+                    elif base in self.modules:
+                        mod.imports[key] = ("sym", base, alias.name)
+
+    def _in_project(self, dotted: str) -> bool:
+        return dotted == self.package_name or dotted.startswith(self.package_name + ".")
+
+    def _resolve_from(self, mod: ModuleInfo, node: ast.ImportFrom) -> str | None:
+        if node.level == 0:
+            return node.module if node.module and self._in_project(node.module) else None
+        # relative import: walk up from the containing package
+        base_parts = mod.name.split(".")
+        # a package's own name counts as one level; a module's parent too
+        is_pkg = mod.path.name == "__init__.py"
+        up = node.level - (1 if is_pkg else 0)
+        if up >= len(base_parts):
+            return None
+        parent = base_parts[: len(base_parts) - up]
+        if node.module:
+            parent = parent + node.module.split(".")
+        dotted = ".".join(parent)
+        return dotted if self._in_project(dotted) else None
+
+    # -- resolution ----------------------------------------------------
+
+    def resolve_function(
+        self, mod: ModuleInfo, expr: ast.AST, _depth: int = 0
+    ) -> tuple[ModuleInfo, ast.FunctionDef] | None:
+        """Resolve an expression to a project function def, unwrapping
+        ``jax.vmap(f)`` / ``functools.partial(f, ...)`` / ``jax.checkpoint``
+        wrappers and following one level of module-local assignment
+        (``g = jax.jit(f)`` style)."""
+        if _depth > 6:
+            return None
+        if isinstance(expr, ast.Call):
+            chain = _dotted(expr.func) or ""
+            leaf = chain.rsplit(".", 1)[-1]
+            if leaf in {"vmap", "partial", "checkpoint", "named_call", "wraps"}:
+                if expr.args:
+                    return self.resolve_function(mod, expr.args[0], _depth + 1)
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in mod.functions:
+                return mod, mod.functions[expr.id]
+            imp = mod.imports.get(expr.id)
+            if imp and imp[0] == "sym":
+                target = self.modules.get(imp[1])
+                if target and imp[2] in target.functions:
+                    return target, target.functions[imp[2]]
+            # module-local alias: name = <expr> at module top level
+            assigned = self._module_assignment(mod, expr.id)
+            if assigned is not None:
+                return self.resolve_function(mod, assigned, _depth + 1)
+            return None
+        if isinstance(expr, ast.Attribute):
+            chain = _dotted(expr)
+            if chain is None:
+                return None
+            head, _, rest = chain.partition(".")
+            imp = mod.imports.get(head)
+            if imp is None or not rest:
+                return None
+            if imp[0] in ("mod", "modroot"):
+                modname = imp[1] if imp[0] == "mod" else chain.rsplit(".", 1)[0]
+                target = self.modules.get(modname)
+                leaf = chain.rsplit(".", 1)[-1]
+                if target and leaf in target.functions:
+                    return target, target.functions[leaf]
+        return None
+
+    @staticmethod
+    def _module_assignment(mod: ModuleInfo, name: str) -> ast.AST | None:
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        return node.value
+        return None
+
+
+# ----------------------------------------------------------------------
+# baseline
+
+def load_baseline(path: Path) -> dict[tuple[str, str, str], int]:
+    data = json.loads(path.read_text(encoding="utf-8"))
+    out: dict[tuple[str, str, str], int] = {}
+    for entry in data.get("findings", []):
+        key = (entry["path"], entry["rule"], entry["message"])
+        out[key] = out.get(key, 0) + int(entry.get("count", 1))
+    return out
+
+
+def write_baseline(path: Path, findings: Iterable[Finding]) -> None:
+    counts: dict[tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[f.fingerprint()] = counts.get(f.fingerprint(), 0) + 1
+    entries = [
+        {"path": p, "rule": r, "message": m, "count": c}
+        for (p, r, m), c in sorted(counts.items())
+    ]
+    path.write_text(
+        json.dumps({"version": 1, "findings": entries}, indent=2) + "\n",
+        encoding="utf-8",
+    )
+
+
+# ----------------------------------------------------------------------
+# orchestration
+
+def run_lint(
+    package_dirs: list[Path],
+    root: Path | None = None,
+    baseline: dict[tuple[str, str, str], int] | None = None,
+    overlay: dict[str, str] | None = None,
+    select: set[str] | None = None,
+) -> tuple[list[Finding], list[Finding], list[Finding]]:
+    """Lint the given packages.
+
+    Returns ``(new, baselined, allowed)``: findings not suppressed by
+    anything, findings absorbed by the baseline, and findings silenced
+    by inline allow comments.
+    """
+    from tools.crdtlint.rules import ALL_RULES
+
+    new: list[Finding] = []
+    baselined: list[Finding] = []
+    allowed: list[Finding] = []
+    remaining = dict(baseline or {})
+    for pkg in package_dirs:
+        project = Project(Path(pkg), root=root, overlay=overlay)
+        findings: list[Finding] = []
+        for rule_fn in ALL_RULES:
+            findings.extend(rule_fn(project))
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+            if select and f.rule not in select:
+                continue
+            mod = next(
+                (m for m in project.modules.values() if m.rel == f.path), None
+            )
+            if mod is not None and mod.allowed(f.line, f.rule):
+                allowed.append(f)
+            elif remaining.get(f.fingerprint(), 0) > 0:
+                remaining[f.fingerprint()] -= 1
+                baselined.append(f)
+            else:
+                new.append(f)
+    return new, baselined, allowed

@@ -1,0 +1,87 @@
+"""Rule registry + shared AST helpers for crdtlint rule families."""
+
+from __future__ import annotations
+
+import ast
+
+#: method names treated as in-place mutation of their receiver (for the
+#: lock rule's write inference and the purity rule's arg-mutation check)
+MUTATOR_METHODS = {
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "update", "add", "discard", "setdefault", "sort", "reverse",
+    "appendleft", "popleft", "put", "put_nowait", "write", "truncate",
+}
+
+#: constructors whose product is itself a synchronisation/thread-safe
+#: primitive — attributes holding one are exempt from the lock rule
+#: (guarding a Queue with a lock is the container's job, not ours)
+THREADSAFE_CONSTRUCTORS = {
+    "Lock", "RLock", "Event", "Condition", "Semaphore", "BoundedSemaphore",
+    "Barrier", "Thread", "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+    "local",
+}
+
+
+def call_leaf(node: ast.Call) -> str | None:
+    """Terminal name of a call's func: ``a.b.c(...)`` -> "c"."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def self_attr(node: ast.AST) -> str | None:
+    """``self.X`` -> "X" (None otherwise)."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def has_at_indexer(node: ast.AST) -> bool:
+    """True when an attribute/subscript chain goes through ``.at[...]``
+    — the functional jax update idiom (``x.at[i].set(v)``), which is NOT
+    a mutation of ``x``."""
+    while True:
+        if isinstance(node, ast.Attribute):
+            if node.attr == "at":
+                return True
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        else:
+            return False
+
+
+def iter_function_defs(tree: ast.AST):
+    """Yield every (qualname_parts, FunctionDef) in a module tree."""
+    def walk(node: ast.AST, stack: tuple[str, ...]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield stack + (child.name,), child
+                yield from walk(child, stack + (child.name,))
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, stack + (child.name,))
+            else:
+                yield from walk(child, stack)
+    yield from walk(tree, ())
+
+
+from tools.crdtlint.rules.locks import check_lock_discipline
+from tools.crdtlint.rules.hostsync import check_host_sync
+from tools.crdtlint.rules.purity import check_purity
+from tools.crdtlint.rules.donation import check_donation
+
+ALL_RULES = [
+    check_lock_discipline,
+    check_host_sync,
+    check_purity,
+    check_donation,
+]

@@ -1,0 +1,270 @@
+"""DONATE001 — donation/aliasing hygiene.
+
+``jax.jit(..., donate_argnums=…)`` hands the argument's buffer to XLA:
+after the call the donor array is invalid, and reading it is undefined
+behaviour that *often works* on CPU (where donation may be ignored) and
+corrupts silently on TPU. The rule finds every jit wrapper with
+``donate_argnums``/``donate_argnames``, resolves its call sites through
+the import graph, and flags donated arguments that are read again after
+the call without being rebound.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from tools.crdtlint.engine import Finding, ModuleInfo, Project, _dotted
+from tools.crdtlint.rules import iter_function_defs
+
+RULE = "DONATE001"
+
+
+@dataclasses.dataclass
+class _JitWrapper:
+    name: str  # name the wrapper is bound to in its module
+    mod: str  # module it is defined in
+    donate_argnums: tuple[int, ...]
+    donate_argnames: tuple[str, ...]
+    param_names: tuple[str, ...]  # of the wrapped fn, when resolvable
+    line: int
+
+
+def _donation_kwargs(call: ast.Call) -> tuple[tuple[int, ...], tuple[str, ...]]:
+    nums: tuple[int, ...] = ()
+    names: tuple[str, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums = (v.value,)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                nums = tuple(
+                    e.value
+                    for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                )
+        elif kw.arg == "donate_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names = (v.value,)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                names = tuple(
+                    e.value
+                    for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                )
+    return nums, names
+
+
+def _wrapped_params(project: Project, mod: ModuleInfo, call: ast.Call) -> tuple[str, ...]:
+    if not call.args:
+        return ()
+    resolved = project.resolve_function(mod, call.args[0])
+    if resolved is None:
+        return ()
+    _m, fn = resolved
+    a = fn.args
+    return tuple(p.arg for p in a.posonlyargs + a.args)
+
+
+def _collect_wrappers(project: Project) -> dict[tuple[str, str], _JitWrapper]:
+    """(module, bound name) -> wrapper info, for jit calls with donation."""
+    out: dict[tuple[str, str], _JitWrapper] = {}
+
+    def jit_call(node: ast.AST) -> ast.Call | None:
+        if (
+            isinstance(node, ast.Call)
+            and (_dotted(node.func) or "").rsplit(".", 1)[-1] == "jit"
+        ):
+            return node
+        return None
+
+    for mod in project.modules.values():
+        for node in mod.tree.body:
+            # name = jax.jit(f, donate_argnums=...)
+            if isinstance(node, ast.Assign) and (call := jit_call(node.value)):
+                nums, names = _donation_kwargs(call)
+                if not nums and not names:
+                    continue
+                params = _wrapped_params(project, mod, call)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[(mod.name, t.id)] = _JitWrapper(
+                            t.id, mod.name, nums, names, params, node.lineno
+                        )
+        for fn_node in ast.walk(mod.tree):
+            # @jax.jit(donate_argnums=...) / @partial(jax.jit, donate_argnums=...)
+            if not isinstance(fn_node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in fn_node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                leaf = (_dotted(dec.func) or "").rsplit(".", 1)[-1]
+                if leaf == "jit" or (
+                    leaf == "partial"
+                    and dec.args
+                    and (_dotted(dec.args[0]) or "").rsplit(".", 1)[-1] == "jit"
+                ):
+                    nums, names = _donation_kwargs(dec)
+                    if not nums and not names:
+                        continue
+                    a = fn_node.args
+                    params = tuple(p.arg for p in a.posonlyargs + a.args)
+                    out[(mod.name, fn_node.name)] = _JitWrapper(
+                        fn_node.name, mod.name, nums, names, params, fn_node.lineno
+                    )
+    return out
+
+
+def _resolve_callee(
+    project: Project, mod: ModuleInfo, func: ast.AST
+) -> tuple[str, str] | None:
+    """Resolve a call's func expression to a (module, bound-name) pair."""
+    if isinstance(func, ast.Name):
+        imp = mod.imports.get(func.id)
+        if imp and imp[0] == "sym":
+            return (imp[1], imp[2])
+        return (mod.name, func.id)
+    chain = _dotted(func)
+    if chain is None:
+        return None
+    head, _, rest = chain.partition(".")
+    imp = mod.imports.get(head)
+    if imp and imp[0] == "mod" and rest:
+        return (imp[1], rest)
+    return None
+
+
+def _donated_positions(w: _JitWrapper, call: ast.Call) -> list[ast.Name]:
+    donated: list[ast.Name] = []
+    for i in w.donate_argnums:
+        if i < len(call.args) and isinstance(call.args[i], ast.Name):
+            donated.append(call.args[i])
+    if w.donate_argnames and w.param_names:
+        index = {p: i for i, p in enumerate(w.param_names)}
+        for nm in w.donate_argnames:
+            i = index.get(nm)
+            if i is not None and i < len(call.args) and isinstance(call.args[i], ast.Name):
+                donated.append(call.args[i])
+            for kw in call.keywords:
+                if kw.arg == nm and isinstance(kw.value, ast.Name):
+                    donated.append(kw.value)
+    return donated
+
+
+def _terminating(stmts: list[ast.stmt]) -> bool:
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue)
+    )
+
+
+def _branch_paths(fn: ast.FunctionDef) -> dict[int, tuple]:
+    """Map ``id(node)`` -> chain of ``(id(if_node), branch)`` ancestors,
+    so mutually exclusive If branches are distinguishable. Statements
+    after an else-less If whose body terminates (the early-return idiom)
+    are tagged as that If's implicit else branch."""
+    paths: dict[int, tuple] = {}
+
+    def tag(node: ast.AST, path: tuple) -> None:
+        paths[id(node)] = path
+        if isinstance(node, ast.If):
+            tag(node.test, path)
+            block(node.body, path + ((id(node), "body"),))
+            block(node.orelse, path + ((id(node), "else"),))
+            return
+        for _field, value in ast.iter_fields(node):
+            if (
+                isinstance(value, list)
+                and value
+                and all(isinstance(x, ast.stmt) for x in value)
+            ):
+                block(value, path)
+            elif isinstance(value, list):
+                for c in value:
+                    if isinstance(c, ast.AST):
+                        tag(c, path)
+            elif isinstance(value, ast.AST):
+                tag(value, path)
+
+    def block(stmts: list[ast.stmt], path: tuple) -> None:
+        cur = path
+        for s in stmts:
+            tag(s, cur)
+            if isinstance(s, ast.If) and _terminating(s.body) and not s.orelse:
+                cur = cur + ((id(s), "else"),)
+
+    tag(fn, ())
+    return paths
+
+
+def _exclusive(p1: tuple, p2: tuple) -> bool:
+    """True when the two nodes sit in different branches of one If —
+    i.e. they can never execute in the same pass through the code."""
+    d1 = dict(p1)
+    return any(d1.get(if_id, branch) != branch for if_id, branch in p2)
+
+
+def check_donation(project: Project) -> list[Finding]:
+    wrappers = _collect_wrappers(project)
+    if not wrappers:
+        return []
+    findings: list[Finding] = []
+    for mod in project.modules.values():
+        for _parts, fn in iter_function_defs(mod.tree):
+            calls: list[tuple[ast.Call, _JitWrapper]] = []
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    key = _resolve_callee(project, mod, node.func)
+                    if key in wrappers:
+                        calls.append((node, wrappers[key]))
+            if not calls:
+                continue
+            branch_of = _branch_paths(fn)
+            # for each donated name: flag loads after the call line that
+            # happen before the name is rebound
+            loads = [
+                n
+                for n in ast.walk(fn)
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+            ]
+            stores = [
+                n
+                for n in ast.walk(fn)
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+            ]
+            for call, w in calls:
+                # a multi-line call puts the donor argument's own Name on
+                # a continuation line: everything inside the call's span
+                # is the donation itself, not a read after it
+                call_end = call.end_lineno or call.lineno
+                for donor in _donated_positions(w, call):
+                    rebind = min(
+                        (s.lineno for s in stores
+                         if s.id == donor.id and s.lineno >= call.lineno),
+                        default=None,
+                    )
+                    for ld in loads:
+                        if ld.id != donor.id or ld is donor or ld.lineno <= call_end:
+                            continue
+                        if rebind is not None and ld.lineno > rebind:
+                            continue
+                        if _exclusive(
+                            branch_of.get(id(call), ()), branch_of.get(id(ld), ())
+                        ):
+                            continue  # read in a branch the call never takes
+                        # no line numbers in the message: baseline
+                        # fingerprints are (path, rule, message) and must
+                        # not drift with unrelated edits
+                        findings.append(
+                            Finding(
+                                mod.rel,
+                                ld.lineno,
+                                RULE,
+                                f"{donor.id!r} was donated to {w.name} "
+                                f"(donate_argnums) and read again after the "
+                                f"call: the buffer is invalid after donation",
+                            )
+                        )
+                        break  # one finding per donated call is enough
+    return findings

@@ -1,0 +1,281 @@
+"""SYNC001/SYNC002 — JAX host-sync leaks.
+
+A traced value forced to host (``.item()``, ``.tolist()``,
+``int()``/``float()`` coercion, ``np.asarray``, ``block_until_ready()``)
+inside a ``jax.jit``/``shard_map``/``pallas_call`` program either fails
+tracing or — worse, on the host-driver side — serialises the dispatch
+pipeline and kills compute/transfer overlap (DrJAX-style collectives
+lose exactly the overlap they exist for).
+
+- **SYNC001**: a sync-forcing expression inside a function reachable
+  from a jit entry point. Entry points are discovered by scanning every
+  module for ``jax.jit(f)`` / ``@jax.jit`` / ``@partial(jax.jit, …)`` /
+  ``shard_map(f, …)`` / ``pl.pallas_call(kernel, …)`` and resolving
+  ``f`` through the project import graph (unwrapping ``vmap`` /
+  ``partial`` / ``checkpoint``); reachability then closes over direct
+  calls. Nested defs of a reachable function are reachable (they trace
+  with it).
+- **SYNC002**: ``block_until_ready()`` anywhere in an op-library module
+  (``ops/``, ``parallel/``) even outside traced code — op bodies must
+  leave synchronisation to the caller/bench harness, or carry an
+  explicit ``# crdtlint: allow[host-sync]`` justification.
+
+``int()``/``float()`` on static-shape arithmetic (constants, ``len()``,
+``.shape``/``.ndim``/``.size`` reads) is exempt — those are Python
+values at trace time, not device reads.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.crdtlint.engine import Finding, ModuleInfo, Project, _dotted
+from tools.crdtlint.rules import iter_function_defs
+
+RULE_JIT = "SYNC001"
+RULE_OP = "SYNC002"
+
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_JIT_NAMES = {"jit"}
+_ENTRY_WRAPPERS = {"shard_map", "pallas_call", "pmap"}
+_OP_MODULE_MARKERS = (".ops.", ".parallel.")
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    chain = _dotted(node.func) or ""
+    return chain.rsplit(".", 1)[-1] in _JIT_NAMES
+
+
+def _is_partial_jit(node: ast.Call) -> bool:
+    """``partial(jax.jit, ...)`` / ``functools.partial(jax.jit, ...)``."""
+    chain = _dotted(node.func) or ""
+    if chain.rsplit(".", 1)[-1] != "partial" or not node.args:
+        return False
+    inner = _dotted(node.args[0]) or ""
+    return inner.rsplit(".", 1)[-1] in _JIT_NAMES
+
+
+def _entry_exprs(mod: ModuleInfo) -> list[ast.AST]:
+    """Expressions that become device-traced entry functions."""
+    out: list[ast.AST] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            chain = _dotted(node.func) or ""
+            leaf = chain.rsplit(".", 1)[-1]
+            if leaf in _JIT_NAMES and node.args:
+                out.append(node.args[0])
+            elif leaf in _ENTRY_WRAPPERS and node.args:
+                out.append(node.args[0])
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                chain = _dotted(dec) or ""
+                if chain.rsplit(".", 1)[-1] in _JIT_NAMES:
+                    out.append(ast.Name(id=node.name, ctx=ast.Load()))
+                    out[-1].lineno = node.lineno  # resolvable marker
+                elif isinstance(dec, ast.Call) and (
+                    _is_jit_call(dec) or _is_partial_jit(dec)
+                ):
+                    marker = ast.Name(id=node.name, ctx=ast.Load())
+                    marker.lineno = node.lineno
+                    out.append(marker)
+    return out
+
+
+def _local_defs(fn: ast.FunctionDef) -> dict[str, ast.FunctionDef]:
+    return {
+        n.name: n
+        for n in ast.walk(fn)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n is not fn
+    }
+
+
+def _reachable_functions(project: Project) -> set[int]:
+    """Node-identity closure of everything a trace can enter (keyed by
+    ``id(FunctionDef)``, not name — an untraced host-side function that
+    happens to share a name with a jit entry must not be flagged)."""
+    work: list[tuple[ModuleInfo, ast.FunctionDef]] = []
+    reach: set[int] = set()
+
+    def push(mod: ModuleInfo, fn: ast.FunctionDef) -> None:
+        if id(fn) in reach:
+            return
+        reach.add(id(fn))
+        work.append((mod, fn))
+
+    for mod in project.modules.values():
+        for expr in _entry_exprs(mod):
+            resolved = project.resolve_function(mod, expr)
+            if resolved is not None:
+                push(*resolved)
+
+    while work:
+        mod, fn = work.pop()
+        local = _local_defs(fn)
+        for nested in local.values():
+            push(mod, nested)  # nested defs trace with their parent
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            # shard_map(step, ...)/jit inside a reachable fn: resolve arg
+            chain = _dotted(node.func) or ""
+            leaf = chain.rsplit(".", 1)[-1]
+            if leaf in (_ENTRY_WRAPPERS | _JIT_NAMES) and node.args:
+                tgt = node.args[0]
+                if isinstance(tgt, ast.Name) and tgt.id in local:
+                    push(mod, local[tgt.id])
+                    continue
+            if isinstance(node.func, ast.Name) and node.func.id in local:
+                push(mod, local[node.func.id])
+                continue
+            resolved = project.resolve_function(mod, node.func)
+            if resolved is not None:
+                push(*resolved)
+    return reach
+
+
+def _static_shape_only(node: ast.AST) -> bool:
+    """True when the expression is trace-time Python arithmetic: built
+    from constants, ``len()``, and ``.shape``/``.ndim``/``.size`` reads."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("shape", "ndim", "size", "dtype", "nbytes")
+    if isinstance(node, ast.Subscript):
+        return _static_shape_only(node.value)
+    if isinstance(node, ast.Call):
+        # bare builtins only: x.sum() is a device reduction, len(x) the
+        # trace-time leading dim
+        if isinstance(node.func, ast.Name):
+            if node.func.id == "len":
+                return True
+            if node.func.id in ("min", "max"):
+                return bool(node.args) and all(
+                    _static_shape_only(a) for a in node.args
+                )
+        return False
+    if isinstance(node, ast.BinOp):
+        return _static_shape_only(node.left) and _static_shape_only(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _static_shape_only(node.operand)
+    return False
+
+
+def _numpy_aliases(mod: ModuleInfo) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name in ("numpy", "numpy.ma"):
+                    out.add(alias.asname or "numpy")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "numpy":
+                for alias in node.names:
+                    if alias.name in ("asarray", "array"):
+                        out.add(alias.asname or alias.name)
+    return out
+
+
+def _sync_findings_in(
+    mod: ModuleInfo, fn: ast.FunctionDef, np_aliases: set[str]
+) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_METHODS:
+            findings.append(
+                Finding(
+                    mod.rel,
+                    node.lineno,
+                    RULE_JIT,
+                    f".{node.func.attr}() forces a host sync inside jit-traced "
+                    f"code ({mod.name}.{fn.name} is reachable from a jit/"
+                    f"shard_map entry point)",
+                )
+            )
+            continue
+        chain = _dotted(node.func) or ""
+        if chain in ("jax.device_get",) or chain.endswith(".device_get"):
+            findings.append(
+                Finding(
+                    mod.rel, node.lineno, RULE_JIT,
+                    f"device_get forces a host transfer inside jit-traced code "
+                    f"({mod.name}.{fn.name})",
+                )
+            )
+            continue
+        head = chain.split(".", 1)[0] if chain else ""
+        if (
+            chain
+            and head in np_aliases
+            and (chain.endswith(".asarray") or chain.endswith(".array")
+                 or chain in np_aliases)
+        ):
+            findings.append(
+                Finding(
+                    mod.rel, node.lineno, RULE_JIT,
+                    f"{chain}(...) materialises a numpy array (host sync) inside "
+                    f"jit-traced code ({mod.name}.{fn.name})",
+                )
+            )
+            continue
+        if isinstance(node.func, ast.Name) and node.func.id in ("int", "float"):
+            if len(node.args) == 1 and not _static_shape_only(node.args[0]):
+                findings.append(
+                    Finding(
+                        mod.rel, node.lineno, RULE_JIT,
+                        f"{node.func.id}() coercion forces a host sync inside "
+                        f"jit-traced code ({mod.name}.{fn.name}); compute with "
+                        f"jnp dtypes or hoist to the host driver",
+                    )
+                )
+    return findings
+
+
+def check_host_sync(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    reach = _reachable_functions(project)
+
+    for mod in project.modules.values():
+        np_aliases = _numpy_aliases(mod)
+        flagged_lines: set[int] = set()
+        for _parts, fn in iter_function_defs(mod.tree):
+            if id(fn) in reach:
+                for f in _sync_findings_in(mod, fn, np_aliases):
+                    if f.line not in flagged_lines:
+                        flagged_lines.add(f.line)
+                        findings.append(f)
+        # SYNC002: block_until_ready anywhere in op-library modules
+        if any(marker in mod.name + "." for marker in _OP_MODULE_MARKERS):
+            for node in ast.walk(mod.tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "block_until_ready"
+                    and node.lineno not in flagged_lines
+                ):
+                    findings.append(
+                        Finding(
+                            mod.rel,
+                            node.lineno,
+                            RULE_OP,
+                            "block_until_ready() in an op-library module: "
+                            "synchronisation belongs to the caller/bench "
+                            "harness, not the op body",
+                        )
+                    )
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "block_until_ready"
+                    and node.lineno not in flagged_lines
+                ):
+                    findings.append(
+                        Finding(
+                            mod.rel, node.lineno, RULE_OP,
+                            "block_until_ready() in an op-library module: "
+                            "synchronisation belongs to the caller/bench "
+                            "harness, not the op body",
+                        )
+                    )
+    return findings

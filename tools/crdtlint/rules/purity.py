@@ -1,0 +1,145 @@
+"""PURE001–PURE003 — lattice-op purity.
+
+``join``/``merge``/``delta`` functions are the correctness foundation of
+the framework: anti-entropy assumes they are pure functions of their
+inputs — idempotent, commutative, associative joins over the delta
+lattice (Almeida et al.). A join that mutates an argument pytree,
+consults module state, or reads a clock produces states that diverge
+replica-to-replica in ways no test of a single replica will catch.
+
+Scope: functions (including methods) whose name contains a ``join``,
+``merge`` or ``delta`` token, in ``ops/`` and ``models/`` modules.
+
+- **PURE001** — argument mutation: assignment/del through a parameter
+  (``arg.x = …``, ``arg[k] = …``) or an in-place mutator call on one
+  (``arg.update(…)``). The functional jax idiom ``arg.at[i].set(v)`` is
+  of course exempt.
+- **PURE002** — module-global writes: ``global X`` declarations.
+- **PURE003** — nondeterminism: calls into ``time``/``random``/
+  ``np.random``/``secrets``/``datetime.now``/``uuid``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.crdtlint.engine import Finding, ModuleInfo, Project, _dotted
+from tools.crdtlint.rules import MUTATOR_METHODS, has_at_indexer, iter_function_defs
+
+RULE_MUT = "PURE001"
+RULE_GLOBAL = "PURE002"
+RULE_IMPURE = "PURE003"
+
+_NAME_RE = re.compile(r"(^|_)(join|merge|delta)(_|$|s$)")
+_SCOPE_MARKERS = (".ops.", ".models.")
+_IMPURE_ROOTS = {"time", "random", "secrets", "uuid"}
+_IMPURE_CHAINS = ("np.random.", "numpy.random.", "datetime.")
+
+
+def _in_scope(mod: ModuleInfo) -> bool:
+    return any(m in mod.name + "." for m in _SCOPE_MARKERS)
+
+
+def _param_names(fn: ast.FunctionDef) -> set[str]:
+    a = fn.args
+    names = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    names.discard("self")
+    names.discard("cls")
+    return names
+
+
+def _root_name(node: ast.AST) -> str | None:
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+        node = node.func if isinstance(node, ast.Call) else node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _check_function(mod: ModuleInfo, fn: ast.FunctionDef) -> list[Finding]:
+    findings: list[Finding] = []
+    params = _param_names(fn)
+    qual = f"{mod.name}.{fn.name}"
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            findings.append(
+                Finding(
+                    mod.rel, node.lineno, RULE_GLOBAL,
+                    f"lattice op {qual} declares global "
+                    f"{', '.join(node.names)}: joins must not touch module "
+                    f"state",
+                )
+            )
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target] if isinstance(node, ast.AugAssign) else node.targets
+            )
+            for t in targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    root = _root_name(t)
+                    if root in params and not has_at_indexer(t):
+                        findings.append(
+                            Finding(
+                                mod.rel, t.lineno, RULE_MUT,
+                                f"lattice op {qual} mutates argument "
+                                f"{root!r} in place: joins must return new "
+                                f"values (use .at[...].set or rebuild the "
+                                f"pytree)",
+                            )
+                        )
+        elif isinstance(node, ast.Call):
+            chain = _dotted(node.func) or ""
+            root = chain.split(".", 1)[0] if chain else ""
+            if root in _IMPURE_ROOTS or any(
+                chain.startswith(p) for p in _IMPURE_CHAINS
+            ):
+                findings.append(
+                    Finding(
+                        mod.rel, node.lineno, RULE_IMPURE,
+                        f"lattice op {qual} calls {chain}(...): joins must be "
+                        f"deterministic pure functions of their inputs",
+                    )
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in MUTATOR_METHODS
+                and _root_name(node.func) in params
+                and not has_at_indexer(node.func)
+            ):
+                findings.append(
+                    Finding(
+                        mod.rel, node.lineno, RULE_MUT,
+                        f"lattice op {qual} calls in-place "
+                        f"{node.func.attr}() on argument "
+                        f"{_root_name(node.func)!r}: joins must not mutate "
+                        f"their inputs",
+                    )
+                )
+    return findings
+
+
+def check_purity(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in project.modules.values():
+        if not _in_scope(mod):
+            continue
+        seen_lines: set[tuple[int, str]] = set()
+        for parts, fn in iter_function_defs(mod.tree):
+            if not _NAME_RE.search(fn.name):
+                continue
+            # nested defs of a matching op are covered by ast.walk of the
+            # parent; skip them as separate roots to avoid double reports
+            if len(parts) >= 2 and _NAME_RE.search(parts[-2]):
+                continue
+            for f in _check_function(mod, fn):
+                key = (f.line, f.rule)
+                if key not in seen_lines:
+                    seen_lines.add(key)
+                    findings.append(f)
+    return findings
