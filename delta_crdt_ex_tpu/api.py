@@ -71,6 +71,18 @@ def start_link(crdt_module=AWLWWMap, *, threaded: bool = True, **opts) -> Replic
     Recovery is automatic: a restarted replica with the same ``name``
     and ``wal_dir`` loads the newest snapshot and replays the log past
     it (torn tail records are truncated, not crashed on).
+
+    Ingress coalescing (on by default): the replica's event loop
+    batch-receives queued sync slices and joins compatible groups with
+    one grouped fan-in kernel dispatch — observably identical to
+    sequential handling, measurably faster under fan-in load. Knobs:
+    ``ingress_coalesce``, ``max_coalesce``, ``ingress_batch``;
+    observability via :meth:`Replica.stats`. WAL segment reclaim is
+    additionally gated on the monitored-neighbour ack watermark
+    (``membership_compaction``) so a lagging peer's catch-up records
+    survive compaction — bounded by ``membership_retain`` records
+    (default ``4 * compact_every``) so a never-acking peer cannot grow
+    the log without limit.
     """
     opts.setdefault("sync_interval", DEFAULT_SYNC_INTERVAL)
     opts.setdefault("max_sync_size", DEFAULT_MAX_SYNC_SIZE)

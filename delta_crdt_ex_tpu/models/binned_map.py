@@ -17,6 +17,7 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from delta_crdt_ex_tpu.models.binned import BinnedStore, pow2_tier as _pow2, pow4_tier as _pow4
@@ -192,6 +193,129 @@ def merge_rows_into(state: BinnedStore, sl, on_grow=None):
                 on_grow(state)
 
 
+#: entry columns of the EntriesMsg wire dict, in RowSlice order
+_WIRE_ENTRY_COLS = ("key", "valh", "ts", "ctr", "alive")
+
+
+def combine_entry_arrays(arrays_list: list) -> "tuple[binned_ops.RowSlice, list]":
+    """Combine k host-plane ``EntriesMsg`` column dicts into ONE
+    :class:`~delta_crdt_ex_tpu.ops.binned.RowSlice` — the ingress
+    coalescing fan-in: instead of k sequential ``merge_rows`` dispatches,
+    the runtime merges the whole group with one.
+
+    Safety preconditions (the caller's grouping rules):
+
+    - bucket rows are pairwise DISJOINT across messages — ``merge_rows``
+      is row-local, so the combined merge then equals the sequential
+      merges bit-for-bit (insert/kill/pack decisions per row see exactly
+      the state the sequential merge would);
+    - entry lane tiers are EQUAL (``key.shape[1]``) — the row-compact
+      sort width is then identical to the per-message merges, so even
+      dead-slot bytes match.
+
+    Writer tables are unioned in first-appearance order (message order,
+    slot order within a message) — the same order sequential
+    ``merge_gid_tables`` calls would append unknown gids in, keeping
+    ``ctx_gid`` bit-identical. Each message's ``node`` column and context
+    columns are remapped into the union table; zero-gid (empty) slots
+    map to a guaranteed-empty padding column so they stay "no local
+    slot" (-1) through the kernel's remap, exactly as before combining.
+
+    Returns ``(slice, offsets)`` where ``offsets[i] = (lo, hi)`` is
+    message i's row range in the combined slice (for per-message
+    accounting over the kernel's per-row counts).
+    """
+    # union writer table, first-appearance order
+    union_idx: dict[int, int] = {}
+    for a in arrays_list:
+        for g in np.asarray(a["ctx_gid"]).tolist():
+            if g != 0 and g not in union_idx:
+                union_idx[g] = len(union_idx)
+    rr_u = len(union_idx)
+    rp = _pow2(rr_u + 1, floor=2)  # ≥1 trailing zero column, tiered
+    null_col = rr_u  # first padding column: gid 0, remaps to -1
+    ctx_gid = np.zeros(rp, np.uint64)
+    if rr_u:
+        ctx_gid[:rr_u] = np.array(list(union_idx), dtype=np.uint64)
+
+    parts: dict[str, list] = {c: [] for c in _WIRE_ENTRY_COLS}
+    rows_parts: list = []
+    node_parts: list = []
+    ctx_rows_parts: list = []
+    ctx_lo_parts: list = []
+    offsets: list[tuple[int, int]] = []
+    off = 0
+    for a in arrays_list:
+        table = np.asarray(a["ctx_gid"])
+        rr_i = table.shape[0]
+        remap = np.full(rr_i, null_col, np.int64)
+        nz = np.nonzero(table)[0]
+        remap[nz] = [union_idx[int(g)] for g in table[nz].tolist()]
+        node = np.asarray(a["node"])
+        node_parts.append(remap[np.clip(node, 0, rr_i - 1)].astype(np.int32))
+        u_i = node.shape[0]
+        crows = np.zeros((u_i, rp), np.uint32)
+        clo = np.zeros((u_i, rp), np.uint32)
+        crows[:, remap[nz]] = np.asarray(a["ctx_rows"])[:, nz]
+        clo[:, remap[nz]] = np.asarray(a["ctx_lo"])[:, nz]
+        ctx_rows_parts.append(crows)
+        ctx_lo_parts.append(clo)
+        rows_parts.append(np.asarray(a["rows"], np.int32))
+        for c in _WIRE_ENTRY_COLS:
+            parts[c].append(np.asarray(a[c]))
+        offsets.append((off, off + u_i))
+        off += u_i
+
+    cols = {c: np.concatenate(parts[c], axis=0) for c in _WIRE_ENTRY_COLS}
+    rows = np.concatenate(rows_parts)
+    node = np.concatenate(node_parts, axis=0)
+    ctx_rows = np.concatenate(ctx_rows_parts, axis=0)
+    ctx_lo = np.concatenate(ctx_lo_parts, axis=0)
+
+    # pad the row axis to the wire tier (bounds distinct compiles); -1
+    # rows are dropped by the kernel's valid mask
+    u_pad = _pow4(max(off, 1))
+    if u_pad != off:
+        pad = u_pad - off
+        rows = np.concatenate([rows, np.full(pad, -1, np.int32)])
+        node = np.concatenate([node, np.zeros((pad,) + node.shape[1:], node.dtype)])
+        ctx_rows = np.concatenate([ctx_rows, np.zeros((pad, rp), np.uint32)])
+        ctx_lo = np.concatenate([ctx_lo, np.zeros((pad, rp), np.uint32)])
+        cols = {
+            c: np.concatenate([v, np.zeros((pad,) + v.shape[1:], v.dtype)])
+            for c, v in cols.items()
+        }
+
+    sl = binned_ops.RowSlice(
+        rows=jnp.asarray(rows),
+        key=jnp.asarray(cols["key"]),
+        valh=jnp.asarray(cols["valh"]),
+        ts=jnp.asarray(cols["ts"]),
+        node=jnp.asarray(node),
+        ctr=jnp.asarray(cols["ctr"]),
+        alive=jnp.asarray(cols["alive"]),
+        ctx_rows=jnp.asarray(ctx_rows),
+        ctx_lo=jnp.asarray(ctx_lo),
+        ctx_gid=jnp.asarray(ctx_gid),
+    )
+    return sl, offsets
+
+
+def merge_group_into(state: BinnedStore, arrays_list: list, on_grow=None):
+    """Grouped fan-in merge: combine k compatible host-plane entry
+    slices (:func:`combine_entry_arrays`) and join them with ONE
+    row-granular kernel dispatch (:func:`merge_rows_into`) — the
+    bench-proven grouped-merge amortisation (one device call for the
+    whole group) landed on the runtime ingress path. Returns
+    ``(new_state, result, offsets)``; raises :class:`CtxGapError` when
+    ANY member's delta-interval gaps (the caller falls back to
+    per-slice handling, which isolates and repairs the gapped source).
+    """
+    sl, offsets = combine_entry_arrays(arrays_list)
+    new_state, res = merge_rows_into(state, sl, on_grow=on_grow)
+    return new_state, res, offsets
+
+
 def merge_into(
     state: BinnedStore, sl, kill_budget: int = 16, on_grow=None, n_alive: int | None = None
 ):
@@ -245,6 +369,8 @@ class BinnedAWLWWMap:
     tree_from_leaves = staticmethod(jit_tree_from_leaves)
     merge_into = staticmethod(merge_into)
     merge_rows_into = staticmethod(merge_rows_into)
+    merge_group_into = staticmethod(merge_group_into)
+    combine_entry_arrays = staticmethod(combine_entry_arrays)
     RowSlice = binned_ops.RowSlice
 
     @staticmethod

@@ -771,6 +771,14 @@ class MergeRowsResult(NamedTuple):
     need_ctx_gap: jnp.ndarray  # bool: delta-interval not contiguous
     n_inserted: jnp.ndarray  # int32
     n_killed: jnp.ndarray  # int32
+    # per-row counts (int32[U]): a coalesced fan-in merge concatenates
+    # several messages' rows into one slice, and per-message accounting
+    # (telemetry parity with sequential handling) is a host-side sum over
+    # each message's row range — possible only if the kernel reports
+    # per-row, not just slice-total, counts. Scalars above stay for
+    # existing callers (totals == per-row sums).
+    n_ins_row: jnp.ndarray  # int32[U]
+    n_kill_row: jnp.ndarray  # int32[U]
 
 
 def merge_rows(state: BinnedStore, sl: RowSlice) -> MergeRowsResult:
@@ -865,14 +873,18 @@ def merge_rows(state: BinnedStore, sl: RowSlice) -> MergeRowsResult:
         ctx_max=state.ctx_max.at[rows_safe].set(ctx2, mode="drop"),
     )
     ok = ~(gids.overflow | need_fill_grow | need_ctx_gap)
+    n_ins_row = jnp.sum(ins.astype(jnp.int32), axis=1)
+    n_kill_row = jnp.sum(die.astype(jnp.int32), axis=1)
     return MergeRowsResult(
         new_state,
         ok,
         gids.overflow,
         need_fill_grow,
         need_ctx_gap,
-        jnp.sum(ins.astype(jnp.int32)),
-        jnp.sum(die.astype(jnp.int32)),
+        jnp.sum(n_ins_row),
+        jnp.sum(n_kill_row),
+        n_ins_row,
+        n_kill_row,
     )
 
 

@@ -128,6 +128,11 @@ class Replica:
         sync_timeout: float | None = None,
         checkpoint_interval: float = 5.0,
         eager_deltas: bool = True,
+        ingress_coalesce: bool = True,
+        max_coalesce: int = 16,
+        ingress_batch: int = 256,
+        membership_compaction: bool = True,
+        membership_retain: int | None = None,
         gc_interval_ops: int = 4096,
         device=None,
     ):
@@ -224,6 +229,47 @@ class Replica:
         self._neighbours: list[Any] = []
         self._monitors: set[Any] = set()
         self._outstanding: dict[Any, int] = {}
+        #: ingress coalescing (ISSUE 3): the event loop drains a bounded
+        #: batch of queued messages and joins compatible EntriesMsg
+        #: groups with ONE grouped fan-in kernel dispatch instead of one
+        #: dispatch per message — the bench-proven grouped-merge
+        #: amortisation on the live hot path. ``max_coalesce`` bounds
+        #: group depth (compile-shape tiers), ``ingress_batch`` bounds
+        #: one drain.
+        self.ingress_coalesce = bool(ingress_coalesce)
+        self.max_coalesce = int(max_coalesce)
+        self.ingress_batch = int(ingress_batch)
+        #: coalescing observability: depth histogram (group size →
+        #: dispatch count) and message/dispatch totals, served by
+        #: :meth:`stats` — the batching win must be visible in
+        #: production, not just in bench
+        self._coalesce_depths: dict[int, int] = {}
+        self._ingress_messages = 0
+        self._ingress_dispatches = 0
+        self._ingress_gap_fallbacks = 0
+        #: membership-driven WAL compaction (ROADMAP): per monitored
+        #: neighbour, the highest local ``_seq`` that peer is known to
+        #: have fully observed (an acked sync round that opened at that
+        #: seq found the trees equal). Segment reclaim never passes the
+        #: minimum watermark of the monitored set — a known-but-lagging
+        #: peer keeps its catch-up records; once every monitored peer
+        #: acks past a segment it is reclaimed aggressively (the normal
+        #: snapshot-covered path).
+        self.membership_compaction = bool(membership_compaction)
+        #: retention BOUND for the ack gate: a monitored peer that never
+        #: acks (e.g. a pure fan-in aggregator — its tree always differs
+        #: from a single writer's, so walk-equality acks never fire)
+        #: must not pin segment reclaim forever. At most this many
+        #: records of history are retained past the ack floor; a peer
+        #: lagging further falls back to the digest walk, exactly the
+        #: "past compaction horizons" contract of the log-shipping plan.
+        self.membership_retain = (
+            int(membership_retain)
+            if membership_retain is not None
+            else 4 * self.compact_every
+        )
+        self._ack_seq: dict[Any, int] = {}
+        self._sync_open_seq: dict[Any, int] = {}
         self._tree: _LazyLevels | None = None
         #: full-read result cache, maintained INCREMENTALLY by local
         #: flushes whenever it is complete (not None): a local op's
@@ -411,10 +457,40 @@ class Replica:
             }
         )
 
+    def _ack_floor(self) -> int:
+        """Membership compaction gate (ROADMAP open item): the highest
+        seq every MONITORED peer is known to have observed. Segments
+        above it may still be a lagging peer's cheapest catch-up feed
+        (log shipping serves record ranges, digest walks are the
+        fallback), so reclaim stops there; once all monitored peers ack
+        past a segment it reclaims aggressively (the plain
+        snapshot-covered path). No monitored peers — or the gate
+        disabled — means the snapshot alone caps reclaim."""
+        if not self.membership_compaction:
+            return self._seq
+        peers = [n for n in self._monitors if n != self.addr]
+        if not peers:
+            return self._seq
+        return min(self._ack_seq.get(n, 0) for n in peers)
+
+    def _reclaim_floor(self) -> int:
+        """The seq WAL segment reclaim may actually proceed to: the ack
+        floor, bounded below by the ``membership_retain`` horizon and
+        above by the snapshot seq. The ONE definition — compaction and
+        the stats/telemetry surfaces must report the same quantity."""
+        return min(
+            self._seq,
+            max(self._ack_floor(), self._seq - self.membership_retain),
+        )
+
     def _compact_wal(self) -> None:
         """Checkpoint a snapshot and reclaim fully-covered segments —
         the snapshot's ``sequence_number`` caps what replay would ever
-        need, so every record ≤ it is dead weight.
+        need, so every record ≤ it is dead weight for RECOVERY; the
+        membership ack floor (:meth:`_ack_floor`) may keep up to
+        ``membership_retain`` records of them alive for lagging
+        monitored peers (bounded: a peer that never acks must not grow
+        the log forever).
 
         Segments are only DELETED when the checkpoint store is known
         disk-backed (it exposes an ``fsync`` attribute, as
@@ -423,8 +499,9 @@ class Replica:
         trade committed data for process lifetime."""
         t0 = time.perf_counter()
         self.storage_module.write(self.name, self._snapshot())
+        floor = self._reclaim_floor()
         if getattr(self.storage_module, "fsync", None) is not None:
-            deleted, freed = self._wal.compact(self._seq)
+            deleted, freed = self._wal.compact(floor)
         else:
             deleted, freed = 0, 0
             self._wal.rotate()  # still bound the active segment's size
@@ -434,6 +511,7 @@ class Replica:
             {
                 "segments_deleted": deleted,
                 "bytes_reclaimed": freed,
+                "ack_floor": floor,
                 "duration_s": time.perf_counter() - t0,
             },
             {"name": self.name},
@@ -657,6 +735,11 @@ class Replica:
                 a: c for a, c in self._push_cursor.items() if a in addrs
             }
             self._rm_cursor = {a: c for a, c in self._rm_cursor.items() if a in addrs}
+            # removed peers stop gating WAL segment reclaim immediately
+            self._ack_seq = {a: s for a, s in self._ack_seq.items() if a in addrs}
+            self._sync_open_seq = {
+                a: s for a, s in self._sync_open_seq.items() if a in addrs
+            }
             self.sync_to_all()
 
     # ------------------------------------------------------------------
@@ -1143,6 +1226,15 @@ class Replica:
                 )
                 if self.transport.send(n, msg):
                     self._outstanding[n] = now + self.sync_timeout
+                    # ack watermark bookkeeping: an eventual AckMsg for
+                    # this round proves the peer held everything we had
+                    # when the round OPENED. Expired rounds may overlap
+                    # in flight; keep the MINIMUM open seq so a late ack
+                    # from the older round can't claim the newer one's
+                    # coverage.
+                    self._sync_open_seq[n] = min(
+                        self._sync_open_seq.get(n, self._seq), self._seq
+                    )
                 else:
                     logger.debug("tried to sync with a dead neighbour: %r", n)
 
@@ -1261,9 +1353,20 @@ class Replica:
                 self._handle_entries(msg)
             elif isinstance(msg, sync_proto.AckMsg):
                 self._outstanding.pop(msg.clear_addr, None)
+                # trees were equal when the acked round's walk ran, so
+                # the peer covers at least our state at round open — the
+                # membership watermark WAL compaction reclaims up to
+                open_seq = self._sync_open_seq.pop(msg.clear_addr, None)
+                if open_seq is not None:
+                    self._ack_seq[msg.clear_addr] = max(
+                        self._ack_seq.get(msg.clear_addr, 0), open_seq
+                    )
             elif isinstance(msg, Down):
                 self._monitors.discard(msg.addr)
                 self._outstanding.pop(msg.addr, None)
+                # a dead peer must not gate segment reclaim forever
+                self._ack_seq.pop(msg.addr, None)
+                self._sync_open_seq.pop(msg.addr, None)
             else:
                 raise TypeError(f"unknown message: {msg!r}")
 
@@ -1430,9 +1533,7 @@ class Replica:
         want_diffs = self.on_diffs is not None
         keys_b = self._winner_records_rows(rows_np[rows_np >= 0]) if want_diffs else {}
         # payloads first: diff values for incoming winners must resolve
-        self._payloads.update(msg.payloads)
-        for _dot, (key_term, _val) in msg.payloads.items():
-            self._key_terms[key_hash64(key_term)] = key_term
+        self._register_slice_payloads(msg.payloads)
 
         try:
             res = self._merge_with_growth(sl)
@@ -1511,6 +1612,181 @@ class Replica:
         # arrive. (Runs only after the merge: pruning between the payload
         # update and the merge would drop dots about to become alive.)
         self._gc_pressure += len(msg.payloads) + int(res.n_killed)
+        self._maybe_gc()
+
+    def _register_slice_payloads(self, payloads: dict) -> None:
+        """Host bookkeeping for an accepted (or about-to-merge) slice's
+        payload dict — idempotent, so grouped ingest may register a whole
+        group up front and still fall back to per-slice handling."""
+        self._payloads.update(payloads)
+        for _dot, (key_term, _val) in payloads.items():
+            self._key_terms[key_hash64(key_term)] = key_term
+
+    # -- ingress coalescing (ISSUE 3 tentpole) ---------------------------
+
+    @staticmethod
+    def _coalescible(msg) -> "tuple | None":
+        """``(bucket-row set, entry-lane tier)`` when the message may
+        join a grouped fan-in merge; ``None`` forces the per-slice path.
+        Device-plane slices are excluded: combining happens on host, and
+        pulling tensor columns off the device to batch them would trade
+        the data plane for the dispatch win."""
+        a = msg.arrays
+        if not isinstance(a["key"], np.ndarray):
+            return None
+        rows = a["rows"]
+        return frozenset(rows[rows >= 0].tolist()), a["key"].shape[1]
+
+    def _coalesce_groups(self, run: list) -> list:
+        """Partition a consecutive run of ``EntriesMsg``s (arrival
+        order) into groups that are safe to join in ONE kernel call:
+        host-plane slices with EQUAL entry-lane tiers and pairwise
+        DISJOINT bucket rows, at most ``max_coalesce`` deep.
+
+        - Equal lane tiers keep the grouped row-compact sort width
+          identical to per-message merges (bit-for-bit parity, even in
+          dead slots).
+        - Disjoint rows make the grouped join decompose per row —
+          ``merge_rows`` is row-local — so merging the group equals
+          merging its members sequentially.
+        - Greedy in arrival order: a conflicting message CLOSES the
+          current group, so groups merge in arrival order and
+          per-sender slice order is preserved (each sender's
+          delta-interval contiguity is checked in sequence; the
+          ``CtxGapError`` repair still fires per source).
+        """
+        groups: list = []
+        cur: list = []
+        cur_rows: set = set()
+        cur_s = -1
+        for m in run:
+            info = self._coalescible(m)
+            if info is None:
+                if cur:
+                    groups.append(cur)
+                cur, cur_rows, cur_s = [], set(), -1
+                groups.append([m])
+                continue
+            rows, s = info
+            if (
+                cur
+                and s == cur_s
+                and len(cur) < self.max_coalesce
+                and not (rows & cur_rows)
+            ):
+                cur.append(m)
+                cur_rows |= rows
+            else:
+                if cur:
+                    groups.append(cur)
+                cur, cur_rows, cur_s = [m], set(rows), s
+        if cur:
+            groups.append(cur)
+        return groups
+
+    def _count_dispatch(self, depth: int, messages: int) -> None:
+        self._ingress_dispatches += 1
+        self._ingress_messages += messages
+        self._coalesce_depths[depth] = self._coalesce_depths.get(depth, 0) + 1
+
+    def _handle_entries_group(self, msgs: list) -> None:
+        """Drain-and-coalesce ingress: join a group of compatible
+        ``EntriesMsg``s with ONE grouped fan-in kernel dispatch
+        (``merge_group_into``) instead of one ``merge_rows_into``
+        dispatch per message, then emit WAL records, payload updates and
+        telemetry per ORIGINAL message — observable protocol behaviour
+        is unchanged from sequential handling (bit-for-bit, see
+        ``tests/test_ingest_coalesce.py``).
+
+        Per-slice fallbacks: singleton groups (nothing to amortise), a
+        diff subscriber (the before/after winner compare is defined per
+        slice), and a ``CtxGapError`` anywhere in the group (the repair
+        must fire per gapped source)."""
+        if len(msgs) == 1 or self.on_diffs is not None:
+            for m in msgs:
+                self._count_dispatch(1, 1)
+                self._handle_entries(m)
+            return
+        self._flush()
+        t0 = time.perf_counter()
+        # payloads first, whole group: the merged winners' values must
+        # resolve; idempotent, so the gap fallback below re-registers
+        # harmlessly
+        for m in msgs:
+            self._register_slice_payloads(m.payloads)
+        try:
+            with tracing.annotate("crdt.merge_group"):
+                self.state, res, offsets = self.model.merge_group_into(
+                    self.state,
+                    [m.arrays for m in msgs],
+                    on_grow=self._grown_telemetry,
+                )
+        except CtxGapError:
+            # some member's delta-interval is not contiguous with our
+            # context; the grouped join cannot tell which — replay the
+            # group per slice (merges are idempotent), which isolates
+            # the gapped source and answers it with the GetDiffMsg
+            # repair exactly as sequential handling would
+            self._ingress_gap_fallbacks += 1
+            for m in msgs:
+                self._count_dispatch(1, 1)
+                self._handle_entries(m)
+            return
+        depth = len(msgs)
+        self._count_dispatch(depth, depth)
+        dt = time.perf_counter() - t0
+        # cache invalidation once (sequential invalidates per message —
+        # same end state); SYNC_DONE stays per message via the kernel's
+        # per-row counts summed over each message's row range
+        self._tree = None
+        self._read_cache = None
+        self._read_cache_kh = None
+        want_done = telemetry.has_handlers(telemetry.SYNC_DONE)
+        if want_done:
+            ins_row, kill_row = jax.device_get((res.n_ins_row, res.n_kill_row))
+        for i, m in enumerate(msgs):
+            self._seq += 1
+            if want_done:
+                lo, hi = offsets[i]
+                telemetry.execute(
+                    telemetry.SYNC_DONE,
+                    {
+                        "keys_updated_count": int(
+                            ins_row[lo:hi].sum() + kill_row[lo:hi].sum()
+                        )
+                    },
+                    {"name": self.name},
+                )
+            telemetry.execute(
+                telemetry.SYNC_ROUND,
+                {
+                    "duration_s": dt / depth,
+                    "buckets": int(len(m.buckets)),
+                    "entries": len(m.payloads),
+                },
+                {"name": self.name, "plane": "host"},
+            )
+            a, payloads = m.arrays, m.payloads
+            self._durable(
+                lambda a=a, payloads=payloads: {
+                    "kind": "entries",
+                    "seq": self._seq,
+                    "arrays": {c: np.asarray(v) for c, v in a.items()},
+                    "payloads": dict(payloads),
+                }
+            )
+        if telemetry.has_handlers(telemetry.INGEST_COALESCE):
+            telemetry.execute(
+                telemetry.INGEST_COALESCE,
+                {
+                    "depth": depth,
+                    "rows": int(offsets[-1][1]),
+                    "entries": sum(len(m.payloads) for m in msgs),
+                    "duration_s": dt,
+                },
+                {"name": self.name},
+            )
+        self._gc_pressure += sum(len(m.payloads) for m in msgs) + int(res.n_killed)
         self._maybe_gc()
 
     def _merge_with_growth(self, sl):
@@ -1596,12 +1872,110 @@ class Replica:
             self._wake.set()
 
     def process_pending(self) -> int:
-        """Deterministic drive: handle all queued messages now."""
+        """Deterministic drive: handle all queued messages now.
+
+        The mailbox drains in bounded batches (``drain_nowait(addr,
+        max_n)``); with ``ingress_coalesce`` on, consecutive runs of
+        ``EntriesMsg``s inside a batch are partitioned into compatible
+        groups and each group merges with ONE grouped fan-in kernel
+        dispatch (``_handle_entries_group``) instead of one dispatch per
+        message — the replica hot-path half of the bench's grouped-merge
+        win.
+
+        Bounded per call: under SUSTAINED ingress (every drain coming
+        back full) at most ``8 × ingress_batch`` messages are handled
+        before returning, so the threaded event loop's periodic duties
+        (sync ticks, checkpoints, interval-mode WAL fsync) cannot be
+        starved by fan-in load — the senders' ``notify()`` has already
+        set the wake event, so the loop re-enters without sleeping and
+        drains the remainder next iteration."""
+        drain = getattr(self.transport, "drain_nowait", None)
         n = 0
-        for msg in self.transport.drain(self.addr):
-            self.handle(msg)
-            n += 1
+        for _ in range(8):
+            if drain is not None:
+                batch = drain(self.addr, self.ingress_batch)
+            else:  # transports predating the batch-receive API
+                batch = self.transport.drain(self.addr)
+            if not batch:
+                return n
+            n += len(batch)
+            self._handle_batch(batch)
+            if drain is None or len(batch) < self.ingress_batch:
+                return n
         return n
+
+    def _handle_batch(self, msgs: list) -> None:
+        """Handle one drained batch in arrival order, coalescing
+        consecutive runs of ``EntriesMsg``s. Any other message type
+        (walk traffic, acks, ``Down``) closes the current run and is
+        handled in place — nothing is ever reordered across types, so a
+        ``Down`` never passes entries from the same peer. A diff
+        subscriber forces the per-slice path anyway (the before/after
+        winner compare is defined per slice), so skip the grouping pass
+        instead of computing row sets just to discard them."""
+        if not self.ingress_coalesce or self.on_diffs is not None:
+            for m in msgs:
+                self.handle(m)
+            return
+        run: list = []
+        for m in msgs:
+            if isinstance(m, sync_proto.EntriesMsg):
+                run.append(m)
+                continue
+            self._drain_entries_run(run)
+            self.handle(m)
+        self._drain_entries_run(run)
+
+    def _drain_entries_run(self, run: list) -> None:
+        """Merge one run of queued entries, group by group. The lock is
+        taken per GROUP (not per batch): a grouped dispatch is the unit
+        that amortises lock+dispatch overhead, while mutate()/read()
+        callers still interleave between groups exactly as they could
+        between sequential messages."""
+        if not run:
+            return
+        for group in self._coalesce_groups(run):
+            with self._lock:
+                self._handle_entries_group(group)
+        run.clear()
+
+    def stats(self) -> dict:
+        """Observability snapshot (a GenServer-call analog, served under
+        the replica lock like ``ping``). ``ingress`` surfaces the
+        coalescing win in production: the coalesce-depth histogram
+        (group size → dispatches) and the merges-per-dispatch ratio over
+        the batch-drain path; ``wal`` includes the membership ack floor
+        gating segment reclaim."""
+        with self._lock:
+            dispatches = self._ingress_dispatches
+            messages = self._ingress_messages
+            out = {
+                "name": self.name,
+                "node_id": self.node_id,
+                "sequence_number": self._seq,
+                "neighbours": list(self._neighbours),
+                "outstanding_syncs": len(self._outstanding),
+                "payloads": len(self._payloads),
+                "ingress": {
+                    "messages": messages,
+                    "dispatches": dispatches,
+                    "merges_per_dispatch": (
+                        round(messages / dispatches, 3) if dispatches else 0.0
+                    ),
+                    "coalesce_depth_hist": dict(
+                        sorted(self._coalesce_depths.items())
+                    ),
+                    "gap_fallbacks": self._ingress_gap_fallbacks,
+                },
+                "wal": None,
+            }
+            if self._wal is not None:
+                out["wal"] = {
+                    "uncompacted_records": self._wal_unc,
+                    "ack_floor": self._reclaim_floor(),
+                    "segments": len(self._wal.segment_paths()),
+                }
+            return out
 
     def start(self) -> "Replica":
         """Run the periodic anti-entropy loop in a background thread

@@ -664,17 +664,25 @@ class TcpTransport:
 
     # -- deterministic driving (parity with LocalTransport) ----------------
 
-    def drain(self, addr: Hashable) -> list:
+    def drain_nowait(self, addr: Hashable, max_n: int | None = None) -> list:
+        """Pop up to ``max_n`` queued messages (all when ``None``) without
+        blocking — same contract as ``LocalTransport.drain_nowait``:
+        per-mailbox FIFO order is preserved across message types, so a
+        ``Down`` never passes entries from the same peer."""
         with self._lock:
             mb = self._mailboxes.get(self._local_name(addr))
-        out = []
+        out: list = []
         if mb is None:
             return out
-        while True:
+        while max_n is None or len(out) < max_n:
             try:
                 out.append(mb.get_nowait())
             except queue.Empty:
-                return out
+                break
+        return out
+
+    def drain(self, addr: Hashable) -> list:
+        return self.drain_nowait(addr, None)
 
     def pump(self, max_rounds: int = 10_000) -> int:
         delivered = 0

@@ -16,6 +16,7 @@ from typing import Callable
 SYNC_DONE = ("delta_crdt", "sync", "done")  # measurements: keys_updated_count
 CAPACITY_GROWN = ("delta_crdt", "capacity", "grown")  # measurements: capacity, replica_capacity
 SYNC_ROUND = ("delta_crdt", "sync", "round")  # measurements: duration_s, buckets, entries; metadata: name, plane
+INGEST_COALESCE = ("delta_crdt", "ingest", "coalesce")  # measurements: depth, rows, entries, duration_s; metadata: name
 WAL_APPEND = ("delta_crdt", "wal", "append")  # measurements: bytes, records, duration_s
 WAL_COMPACT = ("delta_crdt", "wal", "compact")  # measurements: segments_deleted, bytes_reclaimed, duration_s
 WAL_RECOVER = ("delta_crdt", "wal", "recover")  # measurements: records, bytes, duration_s

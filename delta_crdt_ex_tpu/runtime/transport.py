@@ -102,18 +102,28 @@ class LocalTransport:
 
     # -- driving (deterministic mode) ------------------------------------
 
-    def drain(self, addr: Hashable) -> list:
-        """Pop all queued messages for one address."""
+    def drain_nowait(self, addr: Hashable, max_n: int | None = None) -> list:
+        """Pop up to ``max_n`` queued messages for one address (all of
+        them when ``None``), never blocking. Arrival (FIFO) order is
+        preserved across message types — a ``Down`` is never reordered
+        past entries queued before it from the same peer, which is what
+        lets the replica's ingress coalescing batch-receive without
+        changing protocol semantics."""
         with self._lock:
             mb = self._mailboxes.get(addr)
-        out = []
+        out: list = []
         if mb is None:
             return out
-        while True:
+        while max_n is None or len(out) < max_n:
             try:
                 out.append(mb.get_nowait())
             except queue.Empty:
-                return out
+                break
+        return out
+
+    def drain(self, addr: Hashable) -> list:
+        """Pop all queued messages for one address."""
+        return self.drain_nowait(addr, None)
 
     def pump(self, max_rounds: int = 10_000) -> int:
         """Deterministically deliver messages until quiescent.
