@@ -83,6 +83,16 @@ def start_link(crdt_module=AWLWWMap, *, threaded: bool = True, **opts) -> Replic
     survive compaction — bounded by ``membership_retain`` records
     (default ``4 * compact_every``) so a never-acking peer cannot grow
     the log without limit.
+
+    Log-shipping catch-up (on by default): a replica gaining or
+    re-seeing a neighbour requests that peer's delta-log suffix past
+    its applied watermark (``GetLogMsg``) instead of paying the full
+    digest walk; the server answers bounded ``LogChunkMsg`` runs of
+    full-row slices that merge through the grouped-ingest path, and a
+    request below the log's compaction horizon falls back to the walk
+    only for the pre-horizon prefix. Knobs: ``log_shipping``,
+    ``catchup_chunk_rows``; observability under
+    ``Replica.stats()["catchup"]``.
     """
     opts.setdefault("sync_interval", DEFAULT_SYNC_INTERVAL)
     opts.setdefault("max_sync_size", DEFAULT_MAX_SYNC_SIZE)

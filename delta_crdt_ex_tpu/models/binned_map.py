@@ -109,7 +109,16 @@ class CtxGapError(ValueError):
     fallback — the replica runtime's eager delta pushes do exactly that
     (``runtime/replica.py``: ``_push_deltas`` sends intervals, the
     ``_handle_entries_inner`` catcher answers a gap with a ``GetDiffMsg``
-    full-row repair)."""
+    full-row repair).
+
+    ``gap_rows`` (numpy bool[U], when the row-granular kernel raised) is
+    the per-row gap mask; ``gapped_members`` (set[int], when a grouped
+    fan-in merge raised) maps those rows back to the offending member
+    slices so the caller replays only the gapped senders solo and keeps
+    the clean members in one grouped dispatch."""
+
+    gap_rows = None  # numpy bool[U] from the row-granular kernel
+    gapped_members: "set[int] | None" = None  # member indices of a grouped merge
 
 
 def tier_retry_merge(
@@ -182,7 +191,11 @@ def merge_rows_into(state: BinnedStore, sl, on_grow=None):
         if bool(res.ok):
             return res.state, res
         if bool(res.need_ctx_gap):
-            raise CtxGapError(_CTX_GAP_MSG)
+            err = CtxGapError(_CTX_GAP_MSG)
+            # per-row gap mask (host copy: error path, cost irrelevant)
+            # so grouped callers can isolate the gapped member slices
+            err.gap_rows = np.asarray(res.gap_row)
+            raise err
         if bool(res.need_gid_grow):
             state = state.grow(replica_capacity=state.replica_capacity * 2)
             if on_grow:
@@ -308,11 +321,22 @@ def merge_group_into(state: BinnedStore, arrays_list: list, on_grow=None):
     bench-proven grouped-merge amortisation (one device call for the
     whole group) landed on the runtime ingress path. Returns
     ``(new_state, result, offsets)``; raises :class:`CtxGapError` when
-    ANY member's delta-interval gaps (the caller falls back to
-    per-slice handling, which isolates and repairs the gapped source).
+    ANY member's delta-interval gaps, with ``gapped_members`` set to the
+    offending member indices (mapped from the kernel's per-row gap mask
+    through ``offsets``) so the caller replays only those solo and keeps
+    the clean members in one grouped dispatch.
     """
     sl, offsets = combine_entry_arrays(arrays_list)
-    new_state, res = merge_rows_into(state, sl, on_grow=on_grow)
+    try:
+        new_state, res = merge_rows_into(state, sl, on_grow=on_grow)
+    except CtxGapError as err:
+        if err.gap_rows is not None:
+            err.gapped_members = {
+                i
+                for i, (lo, hi) in enumerate(offsets)
+                if bool(err.gap_rows[lo:hi].any())
+            }
+        raise
     return new_state, res, offsets
 
 

@@ -453,6 +453,9 @@ class SliceView(NamedTuple):
     local_ctx: jnp.ndarray  # uint32[U, R]
     ins: jnp.ndarray  # bool[U, S]: slice entries to insert (s2 ∖ c1)
     need_ctx_gap: jnp.ndarray  # bool
+    gap_row: jnp.ndarray  # bool[U]: the rows whose intervals gap — a
+    # grouped fan-in caller maps these back to the member slices so only
+    # the gapped sender replays solo (the rest stay one grouped dispatch)
     nonempty: jnp.ndarray  # bool[U, Rr]: interval columns claiming anything
 
 
@@ -512,10 +515,11 @@ def _slice_view(state: BinnedStore, sl: RowSlice) -> SliceView:
     ins = sl.alive & valid[:, None] & ~covered_local & (ln >= 0)
     # delta-interval contiguity: advancing ctx to hi is only sound if our
     # context already reaches lo (no unobserved gap beneath the interval)
-    need_ctx_gap = jnp.any(valid[:, None] & (rdense > ldense) & (local_ctx < ldense))
+    gap_row = jnp.any(valid[:, None] & (rdense > ldense) & (local_ctx < ldense), axis=1)
+    need_ctx_gap = jnp.any(gap_row)
     return SliceView(
         valid, rows_safe, rows_clip, gids, rdense, ldense, ln, ln_clip,
-        local_ctx, ins, need_ctx_gap, nonempty,
+        local_ctx, ins, need_ctx_gap, gap_row, nonempty,
     )
 
 
@@ -779,6 +783,11 @@ class MergeRowsResult(NamedTuple):
     # existing callers (totals == per-row sums).
     n_ins_row: jnp.ndarray  # int32[U]
     n_kill_row: jnp.ndarray  # int32[U]
+    #: bool[U] — WHICH rows' delta-intervals gap. A grouped fan-in merge
+    #: concatenates several messages' rows; on ``need_ctx_gap`` the host
+    #: maps the flagged rows back to member slices and replays only the
+    #: gapped senders solo, keeping clean senders in one grouped dispatch.
+    gap_row: jnp.ndarray
 
 
 def merge_rows(state: BinnedStore, sl: RowSlice) -> MergeRowsResult:
@@ -885,6 +894,7 @@ def merge_rows(state: BinnedStore, sl: RowSlice) -> MergeRowsResult:
         jnp.sum(n_kill_row),
         n_ins_row,
         n_kill_row,
+        v.gap_row,
     )
 
 

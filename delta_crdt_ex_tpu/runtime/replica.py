@@ -133,6 +133,8 @@ class Replica:
         ingress_batch: int = 256,
         membership_compaction: bool = True,
         membership_retain: int | None = None,
+        log_shipping: bool = True,
+        catchup_chunk_rows: int = 1024,
         gc_interval_ops: int = 4096,
         device=None,
     ):
@@ -247,6 +249,7 @@ class Replica:
         self._ingress_messages = 0
         self._ingress_dispatches = 0
         self._ingress_gap_fallbacks = 0
+        self._ingress_gap_partitions = 0
         #: membership-driven WAL compaction (ROADMAP): per monitored
         #: neighbour, the highest local ``_seq`` that peer is known to
         #: have fully observed (an acked sync round that opened at that
@@ -270,6 +273,35 @@ class Replica:
         )
         self._ack_seq: dict[Any, int] = {}
         self._sync_open_seq: dict[Any, int] = {}
+        #: log-shipping catch-up (ISSUE 4): a rejoining/lagging peer's
+        #: divergence is exactly the suffix of the originator's delta
+        #: log past the peer's last fully observed seq, so catch-up
+        #: requests WAL record ranges (``GetLogMsg``) and replays the
+        #: shipped row slices through the grouped entries path instead
+        #: of walking the digest tree. ``_applied_seq`` is this
+        #: replica's watermark of each PEER's history (learned from
+        #: walk-equality acks — every ``DiffMsg`` stamps the sender's
+        #: seq — and advanced by applied chunks; persisted in snapshots
+        #: so a restart resumes log-shipped instead of walking).
+        #: ``_catchup`` tracks the one in-flight request per peer
+        #: (requester-paced: the server stays stateless).
+        self.log_shipping = bool(log_shipping)
+        self.catchup_chunk_rows = int(catchup_chunk_rows)
+        self._applied_seq: dict[Any, int] = {}
+        self._catchup: dict[Any, dict] = {}
+        #: per-peer "walk first" floor: a horizon-marked chunk told us
+        #: the span through that seq is unservable by the peer's log
+        #: (compacted, or a serving barrier) — openers must take the
+        #: classic walk until our watermark passes it, or every round
+        #: would re-request the same unservable range
+        self._catchup_walk_floor: dict[Any, int] = {}
+        #: catch-up observability (stats() + telemetry)
+        self._catchup_chunks_served = 0
+        self._catchup_chunks_applied = 0
+        self._catchup_bytes_shipped = 0
+        self._catchup_rows_applied = 0
+        self._catchup_horizon_fallbacks = 0
+        self._catchup_last_duration = 0.0
         self._tree: _LazyLevels | None = None
         #: full-read result cache, maintained INCREMENTALLY by local
         #: flushes whenever it is complete (not None): a local op's
@@ -400,6 +432,12 @@ class Replica:
         self._payloads = dict(snap.payloads)
         self._key_terms = dict(snap.key_terms)
         self.clock.observe(snap.last_ts)
+        # per-peer applied watermarks: restoring them lets the restarted
+        # replica resume log-shipping catch-up where it left off (sound:
+        # recovery replays state at least as far as the snapshot, so the
+        # restored state still covers everything the watermark claims).
+        # __dict__.get, not getattr — legacy pickles lack the field.
+        self._applied_seq = dict(snap.__dict__.get("peer_seqs") or {})
         # the snapshot's read map is unknown until a full pass rebuilds it
         self._read_cache = None
         self._read_cache_kh = None
@@ -412,6 +450,7 @@ class Replica:
             payloads=dict(self._payloads),
             key_terms=dict(self._key_terms),
             last_ts=self.clock._last,
+            peer_seqs=dict(self._applied_seq),
         )
 
     def _persist(self) -> None:
@@ -740,6 +779,12 @@ class Replica:
             self._sync_open_seq = {
                 a: s for a, s in self._sync_open_seq.items() if a in addrs
             }
+            self._catchup = {a: s for a, s in self._catchup.items() if a in addrs}
+            # the sync below opens a round toward every (re)gained peer;
+            # its opener carries our seq + log horizon, and a peer whose
+            # watermark is within the horizon answers with GetLogMsg —
+            # the set_neighbours/rejoin catch-up trigger, riding the
+            # normal one-way opener so sync stays originator → peer
             self.sync_to_all()
 
     # ------------------------------------------------------------------
@@ -1211,32 +1256,49 @@ class Replica:
             self._flush()
             self._monitor_neighbours()
             self._push_deltas()
-            tree = self._ensure_tree()
-            root = np.zeros(1, np.int64)
-            now = time.monotonic()
             for n in list(self._monitors):
                 if n == self.addr:
                     continue
-                expiry = self._outstanding.get(n)
-                if expiry is not None and now < expiry:
-                    continue  # ≤1 in-flight sync per neighbour
-                blocks = sync_proto.make_blocks(tree, 0, root, self.levels_per_round)
-                msg = sync_proto.DiffMsg(
-                    originator=self.addr, frm=self.addr, to=n, level=0, idx=root, blocks=blocks
-                )
-                if self.transport.send(n, msg):
-                    self._outstanding[n] = now + self.sync_timeout
-                    # ack watermark bookkeeping: an eventual AckMsg for
-                    # this round proves the peer held everything we had
-                    # when the round OPENED. Expired rounds may overlap
-                    # in flight; keep the MINIMUM open seq so a late ack
-                    # from the older round can't claim the newer one's
-                    # coverage.
-                    self._sync_open_seq[n] = min(
-                        self._sync_open_seq.get(n, self._seq), self._seq
-                    )
-                else:
-                    logger.debug("tried to sync with a dead neighbour: %r", n)
+                self._open_walk(n)
+
+    def _open_walk(self, n) -> bool:
+        """Open one digest-walk round toward ``n`` (the classic
+        ``DiffMsg`` opener, factored out so the log-shipping horizon
+        fallback can start a walk outside the periodic tick). Respects
+        the ≤1-in-flight slot; returns whether a round was opened.
+        Caller holds the lock."""
+        now = time.monotonic()
+        expiry = self._outstanding.get(n)
+        if expiry is not None and now < expiry:
+            return False  # ≤1 in-flight sync per neighbour
+        tree = self._ensure_tree()
+        root = np.zeros(1, np.int64)
+        blocks = sync_proto.make_blocks(tree, 0, root, self.levels_per_round)
+        # openers advertise the log horizon (memoised — no disk read on
+        # the tick path) so the peer can choose log-shipped catch-up
+        horizon = (
+            self._wal.horizon()
+            if self.log_shipping and self._wal is not None
+            else None
+        )
+        msg = sync_proto.DiffMsg(
+            originator=self.addr, frm=self.addr, to=n, level=0, idx=root,
+            blocks=blocks, seq=self._seq, log_horizon=horizon,
+        )
+        if self.transport.send(n, msg):
+            self._outstanding[n] = now + self.sync_timeout
+            # ack watermark bookkeeping: an eventual AckMsg for
+            # this round proves the peer held everything we had
+            # when the round OPENED. Expired rounds may overlap
+            # in flight; keep the MINIMUM open seq so a late ack
+            # from the older round can't claim the newer one's
+            # coverage.
+            self._sync_open_seq[n] = min(
+                self._sync_open_seq.get(n, self._seq), self._seq
+            )
+            return True
+        logger.debug("tried to sync with a dead neighbour: %r", n)
+        return False
 
     def _push_deltas(self) -> None:
         """Eagerly push this replica's own fresh dots to each neighbour as
@@ -1337,6 +1399,10 @@ class Replica:
             if n in self._monitors:
                 continue
             if self.transport.monitor(self.addr, n):
+                # covers Down-then-up rejoins too: the caller
+                # (sync_to_all) opens a round toward every monitor right
+                # after this, and the opener's seq + log horizon lets the
+                # rejoined peer choose log-shipped catch-up over the walk
                 self._monitors.add(n)
             else:
                 logger.debug("tried to monitor a dead neighbour: %r", n)
@@ -1351,6 +1417,10 @@ class Replica:
                 self._handle_get_diff(msg)
             elif isinstance(msg, sync_proto.EntriesMsg):
                 self._handle_entries(msg)
+            elif isinstance(msg, sync_proto.GetLogMsg):
+                self._handle_get_log(msg)
+            elif isinstance(msg, sync_proto.LogChunkMsg):
+                self._handle_log_chunk(msg)
             elif isinstance(msg, sync_proto.AckMsg):
                 self._outstanding.pop(msg.clear_addr, None)
                 # trees were equal when the acked round's walk ran, so
@@ -1367,6 +1437,12 @@ class Replica:
                 # a dead peer must not gate segment reclaim forever
                 self._ack_seq.pop(msg.addr, None)
                 self._sync_open_seq.pop(msg.addr, None)
+                # a catch-up stream dies with its server: applied chunks
+                # were ordinary idempotent merges, so aborting mid-stream
+                # leaves us consistent — the watermark stands at the last
+                # fully applied chunk, and when the peer rejoins its
+                # next round opener restarts the stream from there
+                self._catchup.pop(msg.addr, None)
             else:
                 raise TypeError(f"unknown message: {msg!r}")
 
@@ -1377,9 +1453,53 @@ class Replica:
             tree, msg.level, msg.idx, msg.blocks, self.max_sync_size
         )
         if len(end_idx) == 0:
-            # trees agree under every compared node ({:ok, []} path)
+            # trees agree under every compared node ({:ok, []} path).
+            # For a ROUND OPENER that is a whole-tree proof: digest
+            # equality ⇒ content equality ⇒ we cover the sender's state
+            # at its stamped seq — the applied watermark log-shipping
+            # resumes from. (A walk can only end empty at a genuine
+            # match: differing parents imply differing children in a
+            # hash tree, so truncation never fakes an equality.)
+            # Mid-walk frames re-verify only the FRONTIER subtrees: the
+            # rest was proven against the sender's state at ROUND OPEN,
+            # so claiming a later frame's stamp would over-claim any
+            # non-frontier writes the sender applied mid-round — those
+            # frames teach us nothing watermark-safe, like the ack path
+            # whose _sync_open_seq bookkeeping bounds claims at round
+            # open for exactly this reason.
+            if (
+                msg.level == 0
+                and msg.originator == msg.frm
+                and msg.seq > self._applied_seq.get(msg.frm, 0)
+            ):
+                self._note_applied_seq(msg.frm, int(msg.seq))
             cleared = self.addr if msg.originator != self.addr else msg.frm
             self.transport.send(msg.originator, sync_proto.AckMsg(clear_addr=cleared))
+            return
+        # log-shipping mode decision (ISSUE 4): on a DIVERGING round
+        # opener from a log-capable originator, a peer whose applied
+        # watermark sits within the advertised horizon answers with a
+        # GetLogMsg — the divergence is exactly the originator's log
+        # suffix past the watermark, so one streamed replay replaces the
+        # level walk (the stream's completion ack clears the round's
+        # in-flight slot). Below the horizon the classic walk continues
+        # unchanged; so does every mid-walk frame.
+        if (
+            self.log_shipping
+            and msg.level == 0
+            and msg.originator == msg.frm
+            and msg.originator != self.addr
+            and msg.log_horizon is not None
+            and msg.seq > self._applied_seq.get(msg.frm, 0) >= msg.log_horizon
+            and self._applied_seq.get(msg.frm, 0)
+            >= self._catchup_walk_floor.get(msg.frm, 0)
+        ):
+            # (the strict `seq > watermark` leg matters: divergence with
+            # a watermark at-or-past the opener's seq means the sender
+            # REGRESSED (recovered with loss) or we hold more than it —
+            # its log has nothing for us, so the classic walk must carry
+            # the edge; an empty catch-up stream would just false-ack)
+            self._request_catchup(msg.frm)
             return
         if end_level == self.tree_depth:
             buckets = end_idx[: int(min(self.max_sync_size, len(end_idx)))]
@@ -1406,6 +1526,7 @@ class Replica:
                 level=end_level,
                 idx=end_idx,
                 blocks=blocks,
+                seq=self._seq,
             ),
         )
 
@@ -1481,13 +1602,22 @@ class Replica:
                 by_peer[n] = arrays
         return by_peer, payloads
 
-    def _send_entries(self, to, buckets: np.ndarray, originator) -> bool:
+    def _extract_rows_wire(self, buckets: np.ndarray, device) -> tuple[dict, dict]:
+        """Extract the given bucket rows as one wire-tier-padded entries
+        body for ``device``'s data plane — THE row-transfer shape,
+        shared by walk entries transfers and log-shipping chunks so the
+        padding convention cannot drift between them."""
         rows = np.full(_wire(max(len(buckets), 1)), -1, np.int32)
         rows[: len(buckets)] = np.asarray(buckets, np.int32)
         sl = self.model.extract_rows(self.state, jnp.asarray(rows))
+        return self._slice_wire(sl, rows, device)
+
+    def _device_of(self, peer):
         device_of = getattr(self.transport, "device_of", None)
-        dev = device_of(to) if device_of is not None else None
-        arrays, payloads = self._slice_wire(sl, rows, dev)
+        return device_of(peer) if device_of is not None else None
+
+    def _send_entries(self, to, buckets: np.ndarray, originator) -> bool:
+        arrays, payloads = self._extract_rows_wire(buckets, self._device_of(to))
         return self.transport.send(
             to,
             sync_proto.EntriesMsg(
@@ -1622,6 +1752,383 @@ class Replica:
         for _dot, (key_term, _val) in payloads.items():
             self._key_terms[key_hash64(key_term)] = key_term
 
+    # -- log-shipping catch-up (ISSUE 4 tentpole) ------------------------
+    #
+    # A rejoining or lagging peer's divergence has a KNOWN shape: the
+    # suffix of this replica's delta log past the peer's last fully
+    # observed seq. Serving that suffix replaces the O(rounds ×
+    # max_sync_size) digest walk with a requester-paced stream of
+    # full-row slices — one round trip per bounded chunk, landing on the
+    # grouped-ingest fast path. The WAL range is used as a CHANGED-
+    # BUCKET INDEX, not replayed literally: re-applying another writer's
+    # ``batch`` ops here would re-mint dots under the wrong writer and
+    # counters (our context may already be ahead via transitive
+    # delivery) and a replayed remove would kill concurrent adds the
+    # original never observed — breaking add-wins. Full-row slices
+    # extracted from current state are the walk's own transfer shape,
+    # so chunk replay is idempotent and bit-comparable with a walk.
+
+    #: watermarks survive Down and set_neighbours churn ON PURPOSE (the
+    #: rejoin is exactly when they pay off), so the dicts need a size
+    #: bound instead of lifecycle pruning: beyond this many peers the
+    #: least-recently-advanced watermark is evicted (that peer's next
+    #: catch-up degrades to a walk — safe, just slower)
+    MAX_PEER_WATERMARKS = 4096
+
+    def _note_applied_seq(self, peer, seq: int) -> None:
+        """Advance (never regress) the applied watermark for ``peer``,
+        keeping the dict LRU-ordered and bounded; a watermark passing
+        the peer's walk floor retires the floor (the walk has healed the
+        unservable span the floor guarded)."""
+        d = self._applied_seq
+        cur = d.pop(peer, 0)  # pop+reinsert: insertion order ≈ recency
+        d[peer] = max(cur, int(seq))
+        while len(d) > self.MAX_PEER_WATERMARKS:
+            d.pop(next(iter(d)))
+        floor = self._catchup_walk_floor
+        if floor and d[peer] >= floor.get(peer, 0):
+            floor.pop(peer, None)
+        while len(floor) > self.MAX_PEER_WATERMARKS:
+            floor.pop(next(iter(floor)))
+
+    def _request_catchup(self, peer) -> None:
+        """Open (or refresh) the one in-flight log-shipping catch-up
+        stream toward ``peer``, resuming from our applied watermark of
+        its history. Normally invoked as the peer-side answer to a
+        diverging round opener (``_handle_diff``), so data keeps flowing
+        originator → peer; callable directly for deterministic drives.
+        Caller holds the lock."""
+        if not self.log_shipping or peer == self.addr:
+            return
+        now = time.monotonic()
+        st = self._catchup.get(peer)
+        if st is not None and now < st["expiry"]:
+            return  # requester-paced: ≤1 outstanding request per peer
+        last = int(self._applied_seq.get(peer, 0))
+        msg = sync_proto.GetLogMsg(
+            frm=self.addr, to=peer, last_seq=last, applied_seq=last
+        )
+        if self.transport.send(peer, msg):
+            self._catchup[peer] = {
+                "t0": now,
+                "expiry": now + self.sync_timeout,
+                "chunks": 0,
+                "horizon": False,
+                # correlates chunks to THIS stream: a chunk answering an
+                # older (timed-out, superseded) request has seq_lo below
+                # the last request's cursor and must not pace follow-ups
+                "last_req": last,
+            }
+
+    def _iter_log_records(self, lo: int, hi: int):
+        """WAL records with ``lo < seq ≤ hi`` in seq order, pulled
+        through the bounded range cursor (so one huge lag never loads
+        the whole log into memory at once)."""
+        cursor = lo
+        while cursor < hi:
+            records, next_seq, exhausted = self._wal.read_range(cursor, hi)
+            yield from records
+            if exhausted or next_seq == cursor:
+                return
+            cursor = next_seq
+
+    def _scan_log_rows(self, lo: int, hi: int) -> tuple[int, set, int, bool, int | None]:
+        """Consume records in ``(lo, hi]`` accumulating the touched-
+        bucket set until the chunk row budget fills. Whole records only:
+        the chunk's ``seq_hi`` becomes the peer's watermark, so a chunk
+        must cover EVERY bucket its seq range touched. Records whose row
+        effects cannot be served bounded-and-indexed are BARRIERS — an
+        unknown kind (written by a newer build: effects unknowable
+        here), or a ``clear`` touching more buckets than the hard row
+        cap (shipping the whole keyspace in one frame would break the
+        every-message-is-bounded invariant). The scan stops BEFORE a
+        barrier; when the barrier is the first record, its seq is
+        returned so the server can answer "walk through here, log-ship
+        after" (an explicit horizon at the barrier). Returns
+        ``(n_records, touched_rows, seq_hi, more, barrier_seq)``."""
+        mask = self.num_buckets - 1
+        hard_cap = 4 * self.catchup_chunk_rows
+        touched: set[int] = set()
+        n_rec = 0
+        seq_hi = lo
+        more = False
+        barrier_seq: int | None = None
+        for rec in self._iter_log_records(lo, hi):
+            if len(touched) >= self.catchup_chunk_rows:
+                more = True  # budget full: this record opens the next chunk
+                break
+            kind = rec.get("kind")
+            rec_rows: set[int] | None = None
+            if kind == "batch":
+                rec_rows = set()
+                for f, key_term, _v in rec["ops"]:
+                    if f == "clear":
+                        # a clear touches every bucket (the kill must
+                        # reach rows now empty on both sides too); past
+                        # the hard cap it is a barrier — classify it
+                        # WITHOUT materializing the full keyspace set
+                        rec_rows = (
+                            set(range(self.num_buckets))
+                            if self.num_buckets <= hard_cap
+                            else None
+                        )
+                        break
+                    rec_rows.add(int(key_hash64(key_term)) & mask)
+            elif kind == "entries":
+                rows = np.asarray(rec["arrays"]["rows"])
+                rec_rows = set(rows[rows >= 0].tolist())
+            # the union-size test short-circuits: the exact (allocating)
+            # union only runs when the cheap count bound says it might
+            # actually exceed the cap
+            if rec_rows is None or (
+                len(touched) + len(rec_rows) > hard_cap
+                and len(touched | rec_rows) > hard_cap
+            ):
+                # barrier: stop before it; first-record barriers are
+                # reported so the serve can point the walk at them
+                if n_rec == 0:
+                    barrier_seq = int(rec["seq"])
+                else:
+                    more = True
+                break
+            touched |= rec_rows
+            n_rec += 1
+            seq_hi = int(rec["seq"])
+        return n_rec, touched, seq_hi, more, barrier_seq
+
+    def _extract_catchup_slices(self, rows_sorted: np.ndarray, device) -> list:
+        """Full-row entry slices (the walk's transfer shape, on the
+        peer's data plane like every other entries transfer) for the
+        touched buckets. Normally ONE slice per chunk — a whole chunk
+        then merges in a single kernel dispatch, the ship-the-stream
+        amortisation — splitting only when a record (e.g. a ``clear``)
+        pushed the chunk past the row budget; the pow4 wire tiers keep
+        the distinct extraction/merge compiles to a handful either way
+        (small chunks land on the exact tiers walk transfers already
+        compiled)."""
+        limit = self.catchup_chunk_rows
+        slices = []
+        for s in range(0, len(rows_sorted), limit):
+            part = np.asarray(rows_sorted[s : s + limit], np.int64)
+            arrays, payloads = self._extract_rows_wire(part, device)
+            slices.append({"buckets": part, "arrays": arrays, "payloads": payloads})
+        return slices
+
+    def _handle_get_log(self, msg: sync_proto.GetLogMsg) -> None:
+        """Serve one bounded catch-up chunk from the WAL window that
+        membership-gated compaction retains. A request below the log's
+        compaction horizon is clamped: the chunk covers ``(horizon,
+        seq_hi]`` with the horizon made explicit, and the pre-horizon
+        prefix heals through a classic digest walk opened alongside."""
+        self._flush()
+        peer = msg.frm
+        # the request's applied_seq is the peer's sound claim of how
+        # much of OUR history it holds — the same watermark walk acks
+        # feed, so membership compaction may advance its reclaim floor
+        # on it. (NOT last_seq: that is a resume cursor, which sits
+        # past barrier spans the peer never received.) A claim BEYOND
+        # our seq is a mixed-history signal (we regressed after
+        # recovery with loss, or the peer talked to a previous
+        # incarnation): never let it reclaim records the peer cannot
+        # have observed (see ROADMAP: an epoch tag would detect this).
+        if self._ack_seq.get(peer, 0) < int(msg.applied_seq) <= self._seq:
+            self._ack_seq[peer] = int(msg.applied_seq)
+        if self._wal is None or not self.log_shipping:
+            # nothing servable: everything is "pre-horizon", heal by
+            # walk — superseding the round whose opener prompted this
+            # request (its slot must not block the fallback walk)
+            self.transport.send(
+                peer,
+                sync_proto.LogChunkMsg(
+                    frm=self.addr, to=peer, seq_lo=int(msg.last_seq),
+                    seq_hi=int(msg.last_seq), more=False,
+                    horizon=self._seq, slices=[],
+                ),
+            )
+            self._outstanding.pop(peer, None)
+            self._open_walk(peer)
+            return
+        t0 = time.perf_counter()
+        horizon = self._wal.horizon()
+        clamped = int(msg.last_seq) < horizon
+        lo = max(int(msg.last_seq), horizon)
+        hi = self._wal.last_seq
+        n_rec, touched, seq_hi, more, barrier_seq = self._scan_log_rows(lo, hi)
+        if barrier_seq is not None:
+            # the next record is unservable by log (unknown kind, or a
+            # clear touching more than the hard row cap): answer an
+            # explicit horizon AT the barrier — the walk covers through
+            # it, log shipping resumes after it
+            clamped, horizon, more = True, barrier_seq, barrier_seq < hi
+        slices = self._extract_catchup_slices(
+            np.sort(np.fromiter(touched, np.int64)), self._device_of(peer)
+        )
+        sent = self.transport.send(
+            peer,
+            sync_proto.LogChunkMsg(
+                frm=self.addr, to=peer, seq_lo=lo, seq_hi=seq_hi,
+                more=more, horizon=horizon if clamped else None,
+                slices=slices,
+            ),
+        )
+        if sent:
+            n_bytes = sum(
+                int(v.nbytes)
+                for s in slices
+                for v in s["arrays"].values()
+                if hasattr(v, "nbytes")
+            )
+            self._catchup_chunks_served += 1
+            self._catchup_bytes_shipped += n_bytes
+            if telemetry.has_handlers(telemetry.CATCHUP_CHUNK):
+                telemetry.execute(
+                    telemetry.CATCHUP_CHUNK,
+                    {
+                        "records": n_rec,
+                        "rows": len(touched),
+                        "entries": sum(len(s["payloads"]) for s in slices),
+                        "bytes": n_bytes,
+                        "duration_s": time.perf_counter() - t0,
+                    },
+                    {"name": self.name, "role": "server", "peer": peer},
+                )
+        if clamped:
+            # the peer answered our round opener with this request, so
+            # that round's in-flight slot is still set — supersede it:
+            # the pre-horizon prefix heals by a FRESH walk, now
+            self._outstanding.pop(peer, None)
+            self._open_walk(peer)
+
+    def _handle_log_chunk(self, msg: sync_proto.LogChunkMsg) -> None:
+        """Apply one catch-up chunk: every slice enters as a synthetic
+        ``EntriesMsg`` through the normal idempotent merge path — the
+        grouped fan-in dispatch coalesces a whole chunk into few kernel
+        calls — then the stream either continues (requester-paced
+        ``GetLogMsg`` from ``seq_hi``) or completes. Bounded work per
+        chunk, one request in flight: catch-up cannot starve sync ticks
+        or fsync duties."""
+        peer = msg.frm
+        st = self._catchup.get(peer)
+        # a chunk belongs to the CURRENT stream only when it answers our
+        # latest request (its served range starts at-or-above the last
+        # requested cursor). Chunks from a superseded, timed-out request
+        # still APPLY (idempotent merges — the data is already here) but
+        # must not pace follow-ups or complete the stream, or each
+        # timeout would fork another full stream re-shipping the suffix.
+        current = st is not None and int(msg.seq_lo) >= int(st["last_req"])
+        t0 = time.perf_counter()
+        ems = [
+            sync_proto.EntriesMsg(
+                originator=peer,
+                frm=peer,
+                to=self.addr,
+                buckets=np.asarray(s["buckets"], np.int64),
+                arrays=s["arrays"],
+                payloads=s["payloads"],
+            )
+            for s in msg.slices
+        ]
+        # one merge dispatch per slice: slices are already chunk-sized
+        # (up to ``catchup_chunk_rows`` rows), so a chunk is a handful
+        # of dispatches at most. Concat-grouping them (the ingest path's
+        # amortisation for MANY SMALL pushes) would round the combined
+        # row count up a pow4 wire tier — up to 4× padded kernel work
+        # for zero dispatch savings.
+        for em in ems:
+            self._handle_entries(em)
+        # full-row slices never gap (ctx_lo = 0), so the chunk's range
+        # (seq_lo, seq_hi] is now covered — but the watermark may only
+        # advance when that range CONNECTS to it (watermark ≥ seq_lo): a
+        # horizon-clamped chunk serves above the compaction horizon and
+        # claiming the unshipped (watermark, horizon] prefix would
+        # silently disable the very walk that heals it. Never regress
+        # either (an unsolicited stale chunk must not rewind).
+        if (
+            self._applied_seq.get(peer, 0) >= int(msg.seq_lo)
+            and int(msg.seq_hi) > self._applied_seq.get(peer, 0)
+        ):
+            self._note_applied_seq(peer, int(msg.seq_hi))
+        self._catchup_chunks_applied += 1
+        self._catchup_rows_applied += sum(len(s["buckets"]) for s in msg.slices)
+        if msg.horizon is not None:
+            self._catchup_horizon_fallbacks += 1
+            if st is not None:
+                st["horizon"] = True
+            # the span through msg.horizon is unservable by this peer's
+            # log: take the classic walk on future openers until our
+            # watermark passes it (a walk equality does exactly that)
+            self._catchup_walk_floor[peer] = max(
+                self._catchup_walk_floor.get(peer, 0), int(msg.horizon)
+            )
+        if telemetry.has_handlers(telemetry.CATCHUP_CHUNK):
+            telemetry.execute(
+                telemetry.CATCHUP_CHUNK,
+                {
+                    "records": 0,
+                    "rows": sum(len(s["buckets"]) for s in msg.slices),
+                    "entries": sum(len(s["payloads"]) for s in msg.slices),
+                    "bytes": sum(
+                        int(v.nbytes)
+                        for s in msg.slices
+                        for v in s["arrays"].values()
+                        if hasattr(v, "nbytes")
+                    ),
+                    "duration_s": time.perf_counter() - t0,
+                },
+                {"name": self.name, "role": "client", "peer": peer},
+            )
+        if msg.more:
+            if not current:
+                return  # a superseded stream's chunk: applied, not paced
+            st["chunks"] += 1
+            st["expiry"] = time.monotonic() + self.sync_timeout
+            # resume past any barrier horizon: a chunk that stopped AT a
+            # record the log cannot serve (seq_hi == seq_lo, horizon at
+            # the barrier) continues above it — the walk covers the
+            # barrier itself, and the watermark gate above keeps the
+            # skipped span out of our coverage claim
+            nxt = max(int(msg.seq_hi), int(msg.horizon or 0))
+            st["last_req"] = nxt
+            if not self.transport.send(
+                peer,
+                sync_proto.GetLogMsg(
+                    frm=self.addr, to=peer, last_seq=nxt,
+                    # resume cursor ≠ coverage claim: only the applied
+                    # watermark may move the server's compaction floor
+                    applied_seq=int(self._applied_seq.get(peer, 0)),
+                ),
+            ):
+                self._catchup.pop(peer, None)  # server died mid-stream
+        else:
+            if current:
+                dur = time.monotonic() - st["t0"]
+                self._catchup_last_duration = dur
+                telemetry.execute(
+                    telemetry.CATCHUP_DONE,
+                    {
+                        "chunks": st["chunks"] + 1,
+                        "duration_s": dur,
+                        "horizon_fallback": int(st["horizon"]),
+                    },
+                    {"name": self.name, "peer": peer},
+                )
+                if not st["horizon"]:
+                    # an unclamped stream covered everything up to the
+                    # server's seq_hi ≥ its round-open seq — exactly
+                    # what a walk-equality ack claims, so the same ack
+                    # clears the server's in-flight slot and advances
+                    # its membership-compaction watermark for us. A
+                    # clamped stream left the pre-horizon prefix to the
+                    # walk: no ack, the slot expires and the next round
+                    # walks the remainder.
+                    self.transport.send(
+                        peer, sync_proto.AckMsg(clear_addr=self.addr)
+                    )
+                # only the CURRENT stream's completion retires the
+                # bookkeeping — a superseded stream's final chunk must
+                # not kill the live stream it was replaced by
+                self._catchup.pop(peer, None)
+
     # -- ingress coalescing (ISSUE 3 tentpole) ---------------------------
 
     @staticmethod
@@ -1689,7 +2196,7 @@ class Replica:
         self._ingress_messages += messages
         self._coalesce_depths[depth] = self._coalesce_depths.get(depth, 0) + 1
 
-    def _handle_entries_group(self, msgs: list) -> None:
+    def _handle_entries_group(self, msgs: list, partition: bool = True) -> None:
         """Drain-and-coalesce ingress: join a group of compatible
         ``EntriesMsg``s with ONE grouped fan-in kernel dispatch
         (``merge_group_into``) instead of one ``merge_rows_into``
@@ -1698,10 +2205,15 @@ class Replica:
         is unchanged from sequential handling (bit-for-bit, see
         ``tests/test_ingest_coalesce.py``).
 
-        Per-slice fallbacks: singleton groups (nothing to amortise), a
-        diff subscriber (the before/after winner compare is defined per
-        slice), and a ``CtxGapError`` anywhere in the group (the repair
-        must fire per gapped source)."""
+        Per-slice fallbacks: singleton groups (nothing to amortise) and
+        a diff subscriber (the before/after winner compare is defined
+        per slice). A mid-group ``CtxGapError`` PARTITIONS instead of
+        falling back whole: the kernel's per-row gap mask names the
+        offending member slices, so only the gapped senders replay solo
+        (each answering with its ``GetDiffMsg`` repair) while the clean
+        members retry as one grouped dispatch — merges of disjoint rows
+        commute and the gapped slices merge nothing either way, so the
+        result is bit-identical to sequential handling."""
         if len(msgs) == 1 or self.on_diffs is not None:
             for m in msgs:
                 self._count_dispatch(1, 1)
@@ -1721,11 +2233,27 @@ class Replica:
                     [m.arrays for m in msgs],
                     on_grow=self._grown_telemetry,
                 )
-        except CtxGapError:
-            # some member's delta-interval is not contiguous with our
-            # context; the grouped join cannot tell which — replay the
+        except CtxGapError as err:
+            gapped = err.gapped_members
+            if partition and gapped and 0 < len(gapped) < len(msgs):
+                # coalesce across the gap repair (ROADMAP follow-up):
+                # clean senders stay one grouped dispatch; only the
+                # gapped senders' slices replay solo, where the normal
+                # per-slice catcher answers each with GetDiffMsg.
+                # partition=False on the retry: the clean subgroup
+                # re-evaluates gaps against the same state, so a second
+                # gap means the mask lied — full per-slice is the only
+                # safe answer then.
+                self._ingress_gap_partitions += 1
+                clean = [m for i, m in enumerate(msgs) if i not in gapped]
+                self._handle_entries_group(clean, partition=False)
+                for i in sorted(gapped):
+                    self._count_dispatch(1, 1)
+                    self._handle_entries(msgs[i])
+                return
+            # gap location unknown (or everything gapped): replay the
             # group per slice (merges are idempotent), which isolates
-            # the gapped source and answers it with the GetDiffMsg
+            # the gapped sources and answers each with the GetDiffMsg
             # repair exactly as sequential handling would
             self._ingress_gap_fallbacks += 1
             for m in msgs:
@@ -1966,6 +2494,16 @@ class Replica:
                         sorted(self._coalesce_depths.items())
                     ),
                     "gap_fallbacks": self._ingress_gap_fallbacks,
+                    "gap_partitions": self._ingress_gap_partitions,
+                },
+                "catchup": {
+                    "chunks_served": self._catchup_chunks_served,
+                    "chunks_applied": self._catchup_chunks_applied,
+                    "rows_applied": self._catchup_rows_applied,
+                    "bytes_shipped": self._catchup_bytes_shipped,
+                    "horizon_fallbacks": self._catchup_horizon_fallbacks,
+                    "in_flight": len(self._catchup),
+                    "last_duration_s": round(self._catchup_last_duration, 6),
                 },
                 "wal": None,
             }
@@ -1974,6 +2512,9 @@ class Replica:
                     "uncompacted_records": self._wal_unc,
                     "ack_floor": self._reclaim_floor(),
                     "segments": len(self._wal.segment_paths()),
+                    # below this seq log-shipping cannot serve: requests
+                    # under it fall back to the digest walk for the prefix
+                    "horizon": self._wal.horizon(),
                 }
             return out
 

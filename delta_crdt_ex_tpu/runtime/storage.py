@@ -41,6 +41,15 @@ class Snapshot:
     key_terms: dict[int, Any]  # key hash -> key term
     last_ts: int  # clock continuity (LWW monotonicity)
     layout: str = CURRENT_LAYOUT  # engine layout tag (rehydrate checks it)
+    #: per-peer applied watermarks (addr -> that peer's seq this replica
+    #: fully covered when the snapshot was cut) — lets a restarted
+    #: replica resume log-shipping catch-up instead of paying a full
+    #: digest walk. Sound to restore because recovery replays state AT
+    #: LEAST as far as the snapshot: the restored state still covers
+    #: everything the watermark claims. Legacy pickles lack the field
+    #: (read via ``__dict__.get``): catch-up then starts from 0, which
+    #: only over-serves (merges are idempotent).
+    peer_seqs: dict | None = None
 
 
 def require_layout(tag, what: str) -> None:

@@ -21,6 +21,27 @@ anti-entropy (Almeida et al. Algorithm 2 shell, ``causal_crdt.ex:252-289``
 Every message is bounded; truncated divergence heals over subsequent
 rounds (sync is idempotent). Data flows originator → peer only, matching
 the reference's unidirectional edges (``delta_crdt.ex:89-94``).
+
+Log-shipping catch-up (ISSUE 4) rides the same transport: a rejoining
+or lagging peer's divergence has a *known shape* — the suffix of the
+server's per-replica delta log (the WAL) past the peer's last fully
+observed sequence number — so instead of walking the digest tree it
+sends :class:`GetLogMsg` with that watermark and the server answers
+:class:`LogChunkMsg` runs. Watermarks are learned from the walk itself:
+every :class:`DiffMsg` stamps the sender's applied ``seq``, and a walk
+that ends in equality proves the receiver covers the sender's state at
+that seq (digest equality ⇒ content equality). The chunk payload is NOT
+a literal replay of the server's ``batch`` records — replaying another
+writer's local mutation ops at the receiver would re-mint dots under
+the wrong writer/counters and break add-wins once deltas also arrive
+transitively — instead the WAL range is used as a *changed-bucket
+index*: the server ships current full-row slices (``ctx_lo = 0``,
+exactly the walk's entries transfer shape) for every bucket the range
+touched, deduplicated across the range. Chunks therefore merge through
+the normal idempotent entries path, coalesce on the grouped-ingest fast
+path, and are bit-comparable against a digest-walk catch-up. A request
+below the log's compaction horizon is answered with the explicit
+``horizon`` so only the pre-horizon prefix falls back to the tree walk.
 """
 
 from __future__ import annotations
@@ -41,6 +62,22 @@ class DiffMsg:
     level: int  # tree level of the frontier (0 = root)
     idx: np.ndarray  # int64[f] frontier node indices at `level`
     blocks: list[np.ndarray]  # sender digests for levels level..level+j under idx
+    #: the SENDER's applied sequence number when this block was built.
+    #: A walk ending in equality proves the receiver covers the sender's
+    #: state at this seq — the watermark log-shipping catch-up resumes
+    #: from (0 on frames from builds predating log shipping: the
+    #: watermark then stays conservative and catch-up over-serves, which
+    #: is safe — merges are idempotent).
+    seq: int = 0
+    #: the sender's WAL compaction horizon when this is a round OPENER
+    #: from a log-shipping-capable originator (None otherwise). The peer
+    #: compares its applied watermark against it to decide the round's
+    #: mode: watermark within the horizon → answer ``GetLogMsg`` (the
+    #: log suffix IS the divergence, one streamed replay instead of the
+    #: level walk); below it → classic ping-pong. The decision rides the
+    #: opener so data keeps flowing originator → peer only, exactly like
+    #: the ``GetDiffMsg`` leaf fetch.
+    log_horizon: int | None = None
 
 
 @dataclasses.dataclass
@@ -64,6 +101,58 @@ class EntriesMsg:
     buckets: np.ndarray
     arrays: dict[str, np.ndarray]  # DotStore slice columns + ctx tables
     payloads: dict[tuple[int, int, int], tuple[Any, Any]]  # (gid, bucket, ctr) -> (key_term, value)
+
+
+@dataclasses.dataclass
+class GetLogMsg:
+    """Log-shipping catch-up request: "ship me everything you applied
+    past ``last_seq``". The server answers with one
+    :class:`LogChunkMsg`; the requester paces the stream by
+    re-requesting from each chunk's resume point while ``more`` is set,
+    so the server stays stateless and a dead requester leaks nothing.
+
+    ``last_seq`` is the RESUME CURSOR — after a horizon/barrier-clamped
+    chunk it sits past spans the requester never received.
+    ``applied_seq`` is the requester's honest COVERAGE CLAIM (its
+    applied watermark), the only field the server may advance its
+    membership-compaction ack floor from; conflating the two would let
+    a resume past a barrier reclaim records the peer still needs. 0
+    (the pre-field default on old builds) claims nothing."""
+
+    frm: Hashable
+    to: Hashable
+    last_seq: int
+    applied_seq: int = 0
+
+
+@dataclasses.dataclass
+class LogChunkMsg:
+    """One bounded run of log-shipped catch-up state covering the
+    server's applied range ``(seq_lo, seq_hi]``.
+
+    ``slices`` is a list of full-row entry slices (``{"buckets",
+    "arrays", "payloads"}`` — the exact :class:`EntriesMsg` body shape)
+    for every bucket the server's WAL records in the range touched,
+    deduplicated; the receiver feeds them through the normal idempotent
+    entries-merge path. ``horizon`` is set when part of the requested
+    range is unservable by log — the request's ``last_seq`` fell below
+    the compaction horizon, or the next record is a serving BARRIER (an
+    unknown kind, or a ``clear`` touching more buckets than the hard
+    row cap): the chunk then covers only ``(horizon, seq_hi]`` (or
+    nothing, for a barrier) and the span through ``horizon`` must heal
+    by the classic digest walk, which the server opens alongside.
+    Receivers must not advance their applied watermark across an
+    unshipped span (the chunk connects only when their watermark ≥
+    ``seq_lo``). ``more`` means records past the chunk remain —
+    re-request from ``max(seq_hi, horizon)``."""
+
+    frm: Hashable
+    to: Hashable
+    seq_lo: int  # exclusive lower bound actually served
+    seq_hi: int  # inclusive upper bound actually served
+    more: bool  # records past seq_hi remain: re-request from seq_hi
+    horizon: int | None  # set when last_seq was compacted past (see above)
+    slices: list  # [{"buckets": int64[b], "arrays": {...}, "payloads": {...}}]
 
 
 @dataclasses.dataclass

@@ -668,7 +668,10 @@ class TcpTransport:
         """Pop up to ``max_n`` queued messages (all when ``None``) without
         blocking — same contract as ``LocalTransport.drain_nowait``:
         per-mailbox FIFO order is preserved across message types, so a
-        ``Down`` never passes entries from the same peer."""
+        ``Down`` never passes entries from the same peer and log-shipping
+        catch-up frames (``GetLogMsg``/``LogChunkMsg``, whose slice
+        arrays ride the ``_MSGB`` buffer side-channel like any other
+        big-array frame) stay ordered against walk and entries traffic."""
         with self._lock:
             mb = self._mailboxes.get(self._local_name(addr))
         out: list = []

@@ -20,6 +20,8 @@ INGEST_COALESCE = ("delta_crdt", "ingest", "coalesce")  # measurements: depth, r
 WAL_APPEND = ("delta_crdt", "wal", "append")  # measurements: bytes, records, duration_s
 WAL_COMPACT = ("delta_crdt", "wal", "compact")  # measurements: segments_deleted, bytes_reclaimed, duration_s
 WAL_RECOVER = ("delta_crdt", "wal", "recover")  # measurements: records, bytes, duration_s
+CATCHUP_CHUNK = ("delta_crdt", "catchup", "chunk")  # measurements: records, rows, entries, bytes, duration_s; metadata: name, role ("server"|"client"), peer
+CATCHUP_DONE = ("delta_crdt", "catchup", "done")  # measurements: chunks, duration_s, horizon_fallback; metadata: name, peer
 
 _lock = threading.Lock()
 _handlers: dict[tuple, list[Callable]] = defaultdict(list)

@@ -108,7 +108,10 @@ class LocalTransport:
         preserved across message types — a ``Down`` is never reordered
         past entries queued before it from the same peer, which is what
         lets the replica's ingress coalescing batch-receive without
-        changing protocol semantics."""
+        changing protocol semantics, and likewise keeps log-shipping
+        catch-up frames (``GetLogMsg``/``LogChunkMsg``) ordered against
+        the walk and entries traffic they interleave with (a chunk
+        never passes the ``Down`` of the server that sent it)."""
         with self._lock:
             mb = self._mailboxes.get(addr)
         out: list = []

@@ -116,6 +116,49 @@ def _iter_records(blob: bytes, offset: int) -> Iterator[tuple[int, dict]]:
         offset = end
 
 
+def _stream_records(path: str, chunk: int = 256 << 10) -> Iterator[tuple[int, dict]]:
+    """Stream ``(record_bytes, payload)`` from one segment file WITHOUT
+    loading the whole segment: the file is read in ``chunk``-sized
+    blocks and :func:`_iter_records` parses each block's buffer, with
+    the unconsumed tail carried into the next block so records spanning
+    block boundaries (or larger than a block) still parse whole. Stops
+    at the first torn/corrupt record exactly like ``_iter_records`` —
+    range reads over the ACTIVE segment race an in-flight append
+    harmlessly (the half-written tail reads as torn and simply ends the
+    stream; the caller's cursor picks it up next round). The first
+    yielded payload is the segment header."""
+    with open(path, "rb") as f:
+        buf = f.read(max(chunk, len(MAGIC)))
+        if buf[: len(MAGIC)] != MAGIC:
+            return  # torn at birth / foreign file: nothing servable
+        off = len(MAGIC)
+        while True:
+            for end, payload in _iter_records(buf, off):
+                yield end - off, payload
+                off = end
+            # truncation vs corruption: when the buffer already holds
+            # the stuck record WHOLE (length prefix satisfied) and it
+            # still failed to parse, more bytes cannot help — stop, or
+            # a mid-segment CRC tear would rebuffer the rest of the
+            # file quadratically chunk by chunk. When the prefix says
+            # the record is BIGGER than a chunk, read the exact
+            # remainder in one call — chunk-sized nibbling would
+            # re-copy the accumulated buffer once per chunk (quadratic
+            # in the record size).
+            want = chunk
+            if len(buf) - off >= _HEADER.size:
+                length, _crc = _HEADER.unpack_from(buf, off)
+                need = _HEADER.size + length - (len(buf) - off)
+                if need <= 0:
+                    return  # complete but unparseable: corruption
+                want = max(need, chunk)
+            nxt = f.read(want)
+            if not nxt:
+                return
+            buf = buf[off:] + nxt
+            off = 0
+
+
 class WalLog:
     """One replica's write-ahead delta log in ``directory``.
 
@@ -150,6 +193,11 @@ class WalLog:
         self._last_seq = 0  # highest data-record seq ever appended/seen
         self._dirty = False  # bytes written since the last fsync
         self._last_sync = time.monotonic()
+        #: memoised compaction horizon (None = recompute): appends never
+        #: move it (new records extend the servable suffix), only
+        #: compaction and recovery do — so sync openers can stamp it
+        #: every tick without re-reading segment heads
+        self._horizon: int | None = None
 
     # ------------------------------------------------------------------
     # segment bookkeeping
@@ -276,6 +324,7 @@ class WalLog:
         header: dict | None = None
         records: list[dict] = []
         self.recovered_bytes = 0
+        self._horizon = None  # truncation/discard may move the horizon
         self._segments = self._scan_segments()
         for i, seg in enumerate(self._segments):
             is_last = i == len(self._segments) - 1
@@ -339,6 +388,91 @@ class WalLog:
         return header, records
 
     # ------------------------------------------------------------------
+    # range reads (log-shipping catch-up, ISSUE 4)
+
+    @property
+    def last_seq(self) -> int:
+        """Highest data-record seq ever appended or recovered — the
+        upper bound of what a range read can serve."""
+        return self._last_seq
+
+    def horizon(self) -> int:
+        """The compaction horizon: the lowest ``lo`` such that
+        :meth:`read_range` can serve EVERY record in ``(lo, last_seq]``.
+        A catch-up request below it must fall back to the digest walk
+        for the pre-horizon prefix. Computed from the head of the oldest
+        retained segment (one small read — no index to maintain) and
+        memoised: appends only extend the servable suffix, so the value
+        is invalidated by compaction and recovery alone. An empty log's
+        horizon is ``last_seq`` (nothing servable) — memoising that is
+        still sound because the next append's record carries
+        ``last_seq + k`` and serving starts right above the memo."""
+        if self._horizon is None:
+            h: int | None = None
+            for seg in self._scan_segments():
+                header_seen = False
+                for _n, payload in _stream_records(seg.path):
+                    if not header_seen:
+                        header_seen = True
+                        continue
+                    h = int(payload["seq"]) - 1
+                    break
+                if h is not None:
+                    break
+            self._horizon = self._last_seq if h is None else h
+        return self._horizon
+
+    def read_range(
+        self,
+        lo: int,
+        hi: int,
+        *,
+        max_records: int = 4096,
+        max_bytes: int = 4 << 20,
+    ) -> tuple[list[dict], int, bool]:
+        """Serve data records with ``lo < seq ≤ hi``, oldest first,
+        bounded by ``max_records`` / ``max_bytes`` of scanned record
+        payload. Returns ``(records, next_seq, exhausted)`` where
+        ``next_seq`` is the seq of the last record returned (== ``lo``
+        when none) — the cursor a chunked reader resumes from — and
+        ``exhausted`` is True when every record ≤ ``hi`` currently on
+        disk was returned. The scan streams segments record by record
+        (:func:`_stream_records`) and skips segments wholly below the
+        cursor, so a chunked catch-up re-reads at most one segment's
+        prefix per call, never the whole log. Callers serve from the
+        window membership-gated compaction retains (``_ack_floor``);
+        records compacted away are simply absent — detect that with
+        :meth:`horizon`, not here."""
+        records: list[dict] = []
+        seen_bytes = 0
+        cursor = lo
+        segs = self._scan_segments()
+        for i, seg in enumerate(segs):
+            # a segment's records end where the next segment starts;
+            # the final segment runs to the last appended seq
+            end_seq = segs[i + 1].start_seq - 1 if i + 1 < len(segs) else self._last_seq
+            if end_seq <= lo:
+                continue  # wholly below the cursor: skip without reading
+            if seg.start_seq > hi + 1:
+                break
+            header_seen = False
+            for n_bytes, payload in _stream_records(seg.path):
+                if not header_seen:
+                    header_seen = True
+                    continue
+                seq = int(payload["seq"])
+                if seq <= lo:
+                    continue
+                if seq > hi:
+                    return records, cursor, True
+                records.append(payload)
+                cursor = seq
+                seen_bytes += n_bytes
+                if len(records) >= max_records or seen_bytes >= max_bytes:
+                    return records, cursor, cursor >= min(hi, self._last_seq)
+        return records, cursor, True
+
+    # ------------------------------------------------------------------
     # compaction
 
     def compact(self, covered_seq: int) -> tuple[int, int]:
@@ -367,6 +501,7 @@ class WalLog:
             else:
                 keep.append(seg)
         self._segments = keep
+        self._horizon = None  # reclaimed segments raise the horizon
         if deleted and self.fsync_mode != "none":
             fsync_dir(self.directory)
         return deleted, freed

@@ -297,9 +297,11 @@ def test_coalesced_ingest_bit_for_bit_parity(tmp_path):
 
 def test_gap_mid_group_falls_back_and_repairs(tmp_path):
     """A lost earlier push makes one group member non-contiguous: the
-    grouped join raises CtxGapError, handling falls back to per-slice
-    (other members still merge), the gapped source gets the GetDiffMsg
-    repair, and after the repair both receivers converge identically."""
+    grouped join raises CtxGapError with the gapped member identified
+    (per-row gap mask), handling PARTITIONS — the clean member still
+    merges grouped, only the gapped source replays solo and gets the
+    GetDiffMsg repair — and after the repair both receivers converge
+    identically."""
     transport = LocalTransport()
     clock = LogicalClock()
     s1 = _mk_sender(transport, clock, 1)
@@ -325,7 +327,11 @@ def test_gap_mid_group_falls_back_and_repairs(tmp_path):
         assert n == 2  # one gapped push + one good push, consecutive
         r.process_pending()
 
-    assert rc.stats()["ingress"]["gap_fallbacks"] == 1
+    # the coalescer PARTITIONED: the gapped member was identified from
+    # the kernel's per-row gap mask, so the clean member stayed grouped
+    # and no whole-group fallback was needed
+    assert rc.stats()["ingress"]["gap_partitions"] == 1
+    assert rc.stats()["ingress"]["gap_fallbacks"] == 0
     for r in (rc, rs):
         assert r.read() == {k2: "other"}  # gapped slice not applied
     # both receivers asked the gapped source (and only it) for full rows
@@ -384,6 +390,7 @@ def test_coalesce_disabled_matches_old_drain_path(tmp_path):
         "merges_per_dispatch": 0.0,
         "coalesce_depth_hist": {},
         "gap_fallbacks": 0,
+        "gap_partitions": 0,
     }
 
 

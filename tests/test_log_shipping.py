@@ -1,0 +1,844 @@
+"""Log-shipping catch-up (ISSUE 4): a rejoining/lagging peer replays the
+originator's delta-log suffix instead of walking the digest tree.
+
+Covers the WAL range-read cursor (segment boundaries, truncated tails,
+reused ``start_seq``), the horizon fallback contract, the watermark
+learning/persistence path, end-to-end catch-up parity against the
+classic digest walk (bit-for-bit where the workload permits, canonical
+content under unrestricted churn — see the note on ctx-only rows), and
+a Down-mid-stream abort.
+
+Parity note: the walk ships rows whose DIGESTS differ; log shipping
+ships rows the WAL range TOUCHED. The sets coincide except for rows
+whose leaf digest returned to its pre-lag value while the context still
+advanced (an add+remove of a fresh dot in an otherwise untouched
+bucket): log shipping propagates that context advance, the walk lazily
+omits it. Re-merging an identical full row is bit-stable (the row pack
+is a stable sort on aliveness), so scripts that avoid the corner give
+bit-identical receiver states; unrestricted churn scripts assert read
+and canonical alive-dot equality instead.
+"""
+
+import dataclasses
+import os
+import time
+
+import numpy as np
+import pytest
+
+from delta_crdt_ex_tpu import AWLWWMap
+from delta_crdt_ex_tpu.api import start_link
+from delta_crdt_ex_tpu.models.binned import BinnedStore
+from delta_crdt_ex_tpu.runtime import sync as sync_proto, telemetry
+from delta_crdt_ex_tpu.runtime.clock import LogicalClock
+from delta_crdt_ex_tpu.runtime.transport import LocalTransport
+from delta_crdt_ex_tpu.runtime.wal import WalLog
+
+_COLS = tuple(f.name for f in dataclasses.fields(BinnedStore))
+
+
+# ---------------------------------------------------------------------------
+# WAL range-read cursor
+
+
+def _mk_wal(tmp_path, **kw):
+    w = WalLog(str(tmp_path / "log"), fsync_mode="none", **kw)
+    w.bind(7)
+    return w
+
+
+def _append(w, seq, tag="x"):
+    w.append({"kind": "batch", "seq": seq, "ops": [("add", f"{tag}{seq}", seq)], "ts": [seq]})
+    w.commit()
+
+
+def test_read_range_spans_segment_boundaries(tmp_path):
+    w = _mk_wal(tmp_path, segment_bytes=128)  # rolls every couple records
+    for seq in range(1, 13):
+        _append(w, seq)
+    assert len(w.segment_paths()) > 2  # the rolling actually happened
+    records, next_seq, exhausted = w.read_range(0, 12)
+    assert [r["seq"] for r in records] == list(range(1, 13))
+    assert next_seq == 12 and exhausted
+    # mid-log cursor: lo is exclusive, segments below it are skipped
+    records, next_seq, exhausted = w.read_range(5, 9)
+    assert [r["seq"] for r in records] == [6, 7, 8, 9]
+    assert next_seq == 9 and exhausted
+    # bounded read: the cursor resumes exactly after the last record
+    records, next_seq, exhausted = w.read_range(0, 12, max_records=4)
+    assert [r["seq"] for r in records] == [1, 2, 3, 4] and not exhausted
+    records, next_seq, _ = w.read_range(next_seq, 12, max_records=4)
+    assert [r["seq"] for r in records] == [5, 6, 7, 8]
+    # byte budget bounds a read the same way
+    records, next_seq, exhausted = w.read_range(0, 12, max_bytes=1)
+    assert [r["seq"] for r in records] == [1] and not exhausted
+    w.close()
+
+
+def test_read_range_empty_and_out_of_range(tmp_path):
+    w = _mk_wal(tmp_path)
+    assert w.read_range(0, 0) == ([], 0, True)
+    _append(w, 1)
+    _append(w, 2)
+    # lo beyond the log: nothing, exhausted (the requester is ahead)
+    assert w.read_range(5, 9) == ([], 5, True)
+    w.close()
+
+
+def test_read_range_stops_at_truncated_tail(tmp_path):
+    w = _mk_wal(tmp_path)
+    for seq in (1, 2, 3):
+        _append(w, seq)
+    w.close()
+    path = w.segment_paths()[-1]
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 3)  # tear the last record
+    records, next_seq, exhausted = w.read_range(0, 3)
+    assert [r["seq"] for r in records] == [1, 2]
+    assert next_seq == 2  # the cursor never claims the torn record
+    # recovery truncates the same tear away; the range then agrees
+    w2 = WalLog(str(tmp_path / "log"), fsync_mode="none")
+    _header, recs = w2.recover()
+    assert [r["seq"] for r in recs] == [1, 2]
+    assert w2.read_range(0, 9) == (recs, 2, True)
+    w2.close()
+
+
+def test_read_range_handles_records_larger_than_the_read_chunk(tmp_path):
+    """A record bigger than the 256 KiB streaming chunk is read whole
+    via one exact-size read (no per-chunk rebuffering) and round-trips
+    intact."""
+    w = _mk_wal(tmp_path)
+    big = {"kind": "blob", "seq": 1, "data": os.urandom(700 << 10)}
+    w.append(big)
+    w.commit()
+    _append(w, 2)
+    w.close()
+    records, next_seq, exhausted = w.read_range(0, 2)
+    assert [r["seq"] for r in records] == [1, 2] and exhausted
+    assert records[0]["data"] == big["data"]
+
+
+def test_read_range_stops_at_mid_segment_corruption(tmp_path):
+    """A CRC-corrupt record that is fully present (not a short tail)
+    ends the stream immediately — no quadratic rebuffering hunting for
+    bytes that cannot repair it."""
+    w = _mk_wal(tmp_path)
+    for seq in (1, 2, 3):
+        _append(w, seq)
+    w.close()
+    path = w.segment_paths()[-1]
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 2)  # flip a byte inside a middle record's payload
+        byte = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    records, _next, _exhausted = w.read_range(0, 3)
+    assert [r["seq"] for r in records] == [1]  # clean prefix only
+
+
+def test_read_range_after_reused_start_seq(tmp_path):
+    """Recovery that truncates a segment's FIRST record re-mints the
+    same ``seg-<start_seq>`` filename on the next append; the range
+    cursor must serve the re-minted records once, not twice."""
+    w = _mk_wal(tmp_path)
+    _append(w, 1)
+    w.rotate()
+    _append(w, 2)  # opens seg-...2.wal
+    w.close()
+    second = w.segment_paths()[-1]
+    with open(second, "r+b") as f:
+        # tear into the segment's first (only) record: recovery keeps
+        # the header, truncates the record, and seq 2 re-mints into a
+        # segment file with the SAME start_seq
+        f.truncate(os.path.getsize(second) - 3)
+    w2 = WalLog(str(tmp_path / "log"), fsync_mode="none")
+    _header, recs = w2.recover()
+    assert [r["seq"] for r in recs] == [1]
+    _append(w2, 2)
+    records, next_seq, exhausted = w2.read_range(0, 2)
+    assert [r["seq"] for r in records] == [1, 2]
+    assert exhausted
+    w2.close()
+
+
+def test_horizon_tracks_compaction(tmp_path):
+    w = _mk_wal(tmp_path, segment_bytes=128)
+    assert w.horizon() == 0  # empty log: nothing servable, nothing needed
+    for seq in range(1, 13):
+        _append(w, seq)
+    assert w.horizon() == 0  # full history retained
+    w.compact(8)
+    h = w.horizon()
+    assert 0 < h <= 8  # reclaimed segments raised the horizon
+    records, _next, exhausted = w.read_range(h, 12)
+    assert exhausted and [r["seq"] for r in records] == list(range(h + 1, 13))
+    # everything at/above the horizon stays fully servable; below it the
+    # caller must fall back to the walk (records are simply absent)
+    below, _n, _e = w.read_range(0, 12)
+    assert [r["seq"] for r in below] == list(range(h + 1, 13))
+    w.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: replicas over LocalTransport
+
+
+def _mk(transport, clock, name, tmp=None, **opts):
+    kw = dict(
+        threaded=False, transport=transport, clock=clock,
+        capacity=256, tree_depth=6, sync_timeout=0.01,
+    )
+    if tmp is not None:
+        kw.update(wal_dir=str(tmp), fsync_mode="none")
+    kw.update(opts)
+    return start_link(AWLWWMap, name=name, **kw)
+
+
+def _drive(transport, replicas, rounds=8):
+    """Deliver queued messages without opening new sync rounds (so tests
+    can count/inspect the catch-up exchange itself)."""
+    n = 0
+    for _ in range(rounds):
+        moved = 0
+        for r in replicas:
+            for m in transport.drain(r.addr):
+                r.handle(m)
+                moved += 1
+        n += moved
+        if not moved:
+            break
+    return n
+
+
+def _lose_inflight(transport, rep):
+    """Simulate in-flight loss toward ``rep``: its mailbox drains to the
+    floor (the sender already advanced its push cursors)."""
+    return transport.drain(rep.addr)
+
+
+def assert_state_bit_equal(s1, s2, ctx=""):
+    for c in _COLS:
+        assert np.array_equal(
+            np.asarray(getattr(s1, c)), np.asarray(getattr(s2, c))
+        ), (ctx, c)
+
+
+def _alive_dots(rep):
+    """Canonical content fingerprint: every alive dot's full identity,
+    position-independent (the parity form for workloads where log
+    shipping propagates ctx-only rows the walk omits)."""
+    alive = np.asarray(rep.state.alive)
+    u, b = np.nonzero(alive)
+    gid = np.asarray(rep.state.ctx_gid)[np.asarray(rep.state.node)[u, b]]
+    return sorted(
+        zip(
+            np.asarray(rep.state.key)[u, b].tolist(),
+            gid.tolist(),
+            np.asarray(rep.state.ctr)[u, b].tolist(),
+            np.asarray(rep.state.ts)[u, b].tolist(),
+            np.asarray(rep.state.valh)[u, b].tolist(),
+            u.tolist(),
+        )
+    )
+
+
+def test_watermark_learned_from_walk_equality(tmp_path):
+    transport = LocalTransport()
+    clock = LogicalClock()
+    a = _mk(transport, clock, "wm_a", tmp_path / "a")
+    b = _mk(transport, clock, "wm_b")
+    a.set_neighbours([b])
+    transport.pump()
+    for i in range(5):
+        a.mutate("add", [i, i])
+    a.sync_to_all()  # eager pushes deliver; the walk then finds equality
+    transport.pump()
+    assert b.read() == a.read()
+    # the equality ack taught b how much of a's history it covers …
+    assert b._applied_seq.get(a.addr) == a._seq == 5
+    # … and taught a (via AckMsg) the floor its compaction may reclaim to
+    assert a._ack_seq.get(b.addr) == 5
+
+
+def test_midwalk_equality_does_not_advance_watermark(tmp_path):
+    """Mid-walk frames re-verify only the FRONTIER subtrees; the rest
+    was proven against the sender's state at round open. An equality on
+    such a frame must not claim the frame's (possibly newer) seq, or a
+    sender writing mid-round would make the peer's watermark over-claim
+    and log shipping would permanently skip those records."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    a = _mk(transport, clock, "mw_a", tmp_path / "a")
+    b = _mk(transport, clock, "mw_b")
+    a.set_neighbours([b])
+    transport.pump()
+    for i in range(4):
+        a.mutate("add", [i, i])
+    a.sync_to_all()
+    transport.pump()
+    assert b._applied_seq.get(a.addr) == 4
+
+    # a mid-walk continuation frame (level > 0) whose frontier digests
+    # match b's own tree, stamped with a far-future seq: equality fires,
+    # the watermark must NOT jump to 999
+    tree = b._ensure_tree()
+    idx = np.zeros(1, np.int64)
+    blocks = sync_proto.make_blocks(tree, 2, np.zeros(1, np.int64) + 0, 2)
+    b.handle(
+        sync_proto.DiffMsg(
+            originator=a.addr, frm=a.addr, to=b.addr, level=2,
+            idx=idx, blocks=blocks, seq=999, log_horizon=0,
+        )
+    )
+    assert b._applied_seq.get(a.addr) == 4  # unchanged: not an opener
+
+
+def test_superseded_chunk_does_not_fork_streams(tmp_path):
+    """A chunk answering an older, timed-out request still APPLIES
+    (idempotent) but must not pace follow-ups or complete the live
+    stream — otherwise every timeout forks a duplicate full stream."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    a = _mk(transport, clock, "fk_a", tmp_path / "a", catchup_chunk_rows=8)
+    b = _mk(transport, clock, "fk_b")
+    a.set_neighbours([b])
+    transport.pump()
+    a.mutate("add", ["prime", 0])
+    a.sync_to_all()
+    transport.pump()
+    for i in range(40):
+        a.mutate("add", [i, i])
+    a.sync_to_all()
+    _lose_inflight(transport, b)
+    time.sleep(0.02)
+    a.sync_to_all()
+    for m in _lose_inflight(transport, b):
+        b.handle(m)  # opener → request #1 queued at a
+    for m in transport.drain(a.addr):
+        a.handle(m)  # chunk #1 (more=True) queued at b
+    chunk1 = next(
+        m for m in transport.drain(b.addr) if isinstance(m, sync_proto.LogChunkMsg)
+    )
+    assert chunk1.more
+    # the stream times out and restarts before chunk #1 is handled
+    time.sleep(0.02)
+    with b._lock:
+        b._request_catchup(a.addr)  # request #2 (from the old watermark)
+    # now the STALE chunk #1 arrives twice (delayed + duplicated)
+    b.handle(chunk1)
+    b.handle(chunk1)
+    followups = [
+        m for m in transport.drain(a.addr) if isinstance(m, sync_proto.GetLogMsg)
+    ]
+    # the restarted stream's request plus exactly ONE pace: the first
+    # stale delivery matches the restarted cursor (same watermark — it
+    # IS a valid answer) and legitimately paces the stream forward; the
+    # duplicate is recognised as below the advanced cursor and paces
+    # nothing. The buggy behaviour would pace BOTH (three requests,
+    # forked streams re-shipping the suffix).
+    assert len(followups) == 2
+    assert followups[0].last_seq == chunk1.seq_lo  # request #2's cursor
+    assert followups[1].last_seq > chunk1.seq_lo  # the single pace
+    assert b.stats()["catchup"]["in_flight"] == 1
+    # drive to completion: the live stream finishes and converges
+    for m in followups:
+        a.handle(m)
+    for _ in range(12):
+        for m in transport.drain(b.addr):
+            b.handle(m)
+        for m in transport.drain(a.addr):
+            a.handle(m)
+    assert b.read() == a.read()
+    assert b.stats()["catchup"]["in_flight"] == 0
+
+
+def test_catchup_after_inflight_loss_single_roundtrip(tmp_path):
+    """The headline path: pushes lost in flight leave the peer lagging
+    with advanced cursors; the next round opener resolves by ONE
+    GetLog → LogChunk round trip plus the completion ack — no level
+    walk, no GetDiff — and the states match a never-partitioned sync."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    a = _mk(transport, clock, "lr_a", tmp_path / "a")
+    b = _mk(transport, clock, "lr_b")
+    a.set_neighbours([b])
+    transport.pump()
+    for i in range(8):
+        a.mutate("add", [i, i])
+    a.sync_to_all()
+    transport.pump()
+    assert b._applied_seq.get(a.addr) == 8
+
+    for i in range(8, 24):
+        a.mutate("add", [i, i])
+    a.mutate("remove", [0])
+    a.sync_to_all()
+    lost = _lose_inflight(transport, b)
+    assert any(isinstance(m, sync_proto.EntriesMsg) for m in lost)
+    time.sleep(0.02)  # the opener's in-flight slot expires
+
+    a.sync_to_all()
+    kinds = []
+    for _ in range(8):
+        for m in transport.drain(b.addr):
+            kinds.append(type(m).__name__)
+            b.handle(m)
+        for m in transport.drain(a.addr):
+            kinds.append(type(m).__name__)
+            a.handle(m)
+    assert b.read() == a.read() == {i: i for i in range(1, 24)}
+    # the catch-up exchange: opener, log request, one chunk, ack — and
+    # whatever eager pushes rode along; never a GetDiffMsg leaf fetch
+    assert "GetLogMsg" in kinds and "LogChunkMsg" in kinds
+    assert "GetDiffMsg" not in kinds
+    assert kinds.count("LogChunkMsg") == 1
+    assert b.stats()["catchup"]["chunks_applied"] == 1
+    assert a.stats()["catchup"]["chunks_served"] == 1
+    assert a.stats()["catchup"]["bytes_shipped"] > 0
+    # the stream's completion ack cleared the round's in-flight slot and
+    # advanced the server's membership-compaction watermark
+    assert not a._outstanding
+    assert a._ack_seq.get(b.addr) == a._seq
+
+
+def test_catchup_parity_bit_for_bit_vs_digest_walk(tmp_path):
+    """Two identically-seeded receivers, one catching up via log
+    shipping and one via the classic walk, end with BIT-IDENTICAL state
+    arrays (workload avoids the ctx-only corner: fresh adds plus
+    removes of pre-lag keys, so touched rows == differing rows)."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    a = _mk(transport, clock, "pb_a", tmp_path / "a")
+    bl = _mk(transport, clock, "pb_log", node_id=777)
+    bw = _mk(transport, clock, "pb_walk", node_id=777, log_shipping=False)
+    a.set_neighbours([bl, bw])
+    transport.pump()
+    for i in range(12):
+        a.mutate("add", [i, i * 10])
+    a.sync_to_all()
+    transport.pump()
+    assert bl.read() == bw.read() == a.read()
+    assert_state_bit_equal(bl.state, bw.state, "pre-lag")
+
+    # the lag: fresh adds + removes of pre-lag keys, all lost in flight
+    for i in range(12, 40):
+        a.mutate("add", [i, i * 10])
+    for i in range(0, 6):
+        a.mutate("remove", [i])
+    a.sync_to_all()
+    _lose_inflight(transport, bl)
+    _lose_inflight(transport, bw)
+    time.sleep(0.02)
+
+    a.sync_to_all()
+    _drive(transport, [a, bl, bw])
+    assert bl.read() == bw.read() == a.read()
+    assert len(a.read()) == 12 - 6 + 28
+    assert_state_bit_equal(bl.state, bw.state, "post-catchup")
+    assert bl.stats()["catchup"]["chunks_applied"] >= 1
+    assert bw.stats()["catchup"]["chunks_applied"] == 0  # walked
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_catchup_parity_randomized_churn(tmp_path, seed):
+    """Seeded random add/remove churn scripts with repeated partition /
+    reconnect cycles: log-shipping and walk receivers both converge to
+    the writer, with identical reads and identical canonical alive-dot
+    content. (Raw array bytes may differ only on ctx-only rows — the
+    add+remove corner — which log shipping propagates and the walk
+    omits; see the module docstring.)"""
+    rng = np.random.default_rng(seed)
+    transport = LocalTransport()
+    clock = LogicalClock()
+    a = _mk(transport, clock, f"rc_a{seed}", tmp_path / "a")
+    bl = _mk(transport, clock, f"rc_log{seed}", node_id=777)
+    bw = _mk(transport, clock, f"rc_walk{seed}", node_id=777, log_shipping=False)
+    a.set_neighbours([bl, bw])
+    transport.pump()
+    for cycle in range(int(rng.integers(2, 5))):
+        for _ in range(int(rng.integers(1, 16))):
+            ki = int(rng.integers(0, 24))
+            if rng.random() < 0.7:
+                a.mutate("add", [ki, int(rng.integers(0, 100))])
+            else:
+                a.mutate("remove", [ki])
+        a.sync_to_all()
+        if rng.random() < 0.7:  # partition: this round is lost
+            _lose_inflight(transport, bl)
+            _lose_inflight(transport, bw)
+            time.sleep(0.02)
+        else:
+            _drive(transport, [a, bl, bw])
+    # reconnect and settle: repeated rounds (walk may need several)
+    for _ in range(6):
+        time.sleep(0.02)
+        a.sync_to_all()
+        _drive(transport, [a, bl, bw])
+    assert bl.read() == bw.read() == a.read()
+    assert _alive_dots(bl) == _alive_dots(bw) == _alive_dots(a)
+
+
+def test_horizon_fallback_covers_prefix_by_walk(tmp_path):
+    """A peer lagging past the compaction horizon gets the retained
+    suffix as chunks PLUS an explicit horizon; the pre-horizon prefix
+    heals through the classic walk — end state complete either way."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    a = _mk(
+        transport, clock, "hz_a", tmp_path / "a",
+        segment_bytes=256, compact_every=10**9, membership_compaction=False,
+    )
+    b = _mk(transport, clock, "hz_b")
+    a.set_neighbours([b])
+    transport.pump()
+    for i in range(4):
+        a.mutate("add", [i, i])
+    a.sync_to_all()
+    transport.pump()
+    assert b._applied_seq.get(a.addr) == 4
+
+    # the peer misses a long stretch; the writer compacts past its floor
+    for i in range(4, 40):
+        a.mutate("add", [i, i])
+    a.sync_to_all()
+    _lose_inflight(transport, b)
+    a.checkpoint()  # membership gate off: reclaim to the snapshot
+    horizon = a.stats()["wal"]["horizon"]
+    assert horizon > 4  # the peer's floor was compacted past
+
+    time.sleep(0.02)
+    a.sync_to_all()
+    # watermark (4) < advertised horizon → b starts the classic walk;
+    # direct requests under the horizon get the suffix + explicit marker
+    _drive(transport, [a, b])
+    assert b.read() == a.read()
+
+    # direct under-horizon request: explicit horizon + retained suffix
+    b2 = _mk(transport, clock, "hz_b2")
+    transport.send(a.addr, sync_proto.GetLogMsg(frm=b2.addr, to=a.addr, last_seq=0))
+    for m in transport.drain(a.addr):
+        a.handle(m)
+    chunks = [
+        m for m in transport.drain(b2.addr)
+        if isinstance(m, sync_proto.LogChunkMsg)
+    ]
+    assert len(chunks) == 1 and chunks[0].horizon == horizon
+    assert chunks[0].seq_lo == horizon  # served only the post-horizon suffix
+    b2.handle(chunks[0])
+    assert b2.stats()["catchup"]["horizon_fallbacks"] == 1
+    # the clamped chunk did NOT connect to b2's watermark (0 < seq_lo):
+    # claiming seq_hi would silently disable the walk that heals the
+    # unshipped prefix — the watermark must stand until a walk equality
+    assert b2._applied_seq.get(a.addr, 0) == 0
+
+
+def test_clear_record_is_a_serving_barrier(tmp_path):
+    """A ``clear`` touching more buckets than the hard row cap must not
+    ship the whole keyspace in one frame: the serve answers an explicit
+    horizon AT the clear, the walk covers through it, and log shipping
+    resumes above it — the stream never false-acks and the receiver
+    still converges."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    # 64 buckets; hard cap = 4 × catchup_chunk_rows = 16 < 64 → barrier
+    a = _mk(transport, clock, "cl_a", tmp_path / "a", catchup_chunk_rows=4)
+    b = _mk(transport, clock, "cl_b")
+    a.set_neighbours([b])
+    transport.pump()
+    for i in range(6):
+        a.mutate("add", [i, i])
+    a.sync_to_all()
+    transport.pump()
+    watermark = b._applied_seq.get(a.addr)
+    assert watermark == a._seq
+
+    a.mutate("clear", [])
+    for i in range(10, 16):
+        a.mutate("add", [i, i])
+    a.sync_to_all()
+    _lose_inflight(transport, b)
+    time.sleep(0.02)
+    a.sync_to_all()
+    kinds = []
+    for _ in range(16):
+        time.sleep(0.02)  # walk rounds for the barrier span need expiry
+        a.sync_to_all()
+        for m in transport.drain(b.addr):
+            kinds.append(type(m).__name__)
+            b.handle(m)
+        for m in transport.drain(a.addr):
+            kinds.append(type(m).__name__)
+            a.handle(m)
+    assert b.read() == a.read() == {i: i for i in range(10, 16)}
+    barrier_chunks = [1 for k in kinds if k == "LogChunkMsg"]
+    assert barrier_chunks  # the log path answered (with the barrier)
+    # the watermark never claimed the unshipped clear span by log alone:
+    # it reached a's seq only through a genuine walk equality ack
+    assert b._applied_seq.get(a.addr) == a._seq
+
+
+def test_unknown_record_kind_is_a_serving_barrier(tmp_path):
+    """A WAL record kind written by a newer build cannot be indexed by
+    this one: serving must stop at it with an explicit horizon instead
+    of silently skipping it (which would advance the peer's watermark
+    past effects that were never shipped)."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    a = _mk(transport, clock, "uk_a", tmp_path / "a")
+    b = _mk(transport, clock, "uk_b")
+    a.set_neighbours([b])
+    transport.pump()
+    a.mutate("add", ["prime", 0])
+    a.sync_to_all()
+    transport.pump()
+    assert b._applied_seq.get(a.addr) == 1
+
+    # a future build appends a record kind this build does not know
+    a._wal.append({"kind": "from_the_future", "seq": a._seq + 1})
+    a._wal.commit()
+    a._seq += 1
+    for i in range(6):
+        a.mutate("add", [i, i])
+
+    with b._lock:
+        b._request_catchup(a.addr)  # stream from the watermark (1)
+    for m in transport.drain(a.addr):
+        a.handle(m)
+    chunk = next(
+        m for m in transport.drain(b.addr) if isinstance(m, sync_proto.LogChunkMsg)
+    )
+    # barrier at the unknown record: nothing served below it, horizon
+    # names it, more invites the receiver to resume above it
+    assert chunk.horizon == 2 and chunk.seq_hi == 1 and chunk.slices == []
+    assert chunk.more
+    b.handle(chunk)
+    assert b._applied_seq.get(a.addr) == 1  # never advanced past the barrier
+    # the resumed request (sent by the chunk handler) serves the suffix
+    for m in transport.drain(a.addr):
+        a.handle(m)
+    chunk2 = next(
+        m for m in transport.drain(b.addr) if isinstance(m, sync_proto.LogChunkMsg)
+    )
+    assert chunk2.seq_lo == 2 and chunk2.seq_hi == a._seq and chunk2.slices
+    b.handle(chunk2)
+    # still no coverage claim across the barrier — only a walk can ack it
+    assert b._applied_seq.get(a.addr) == 1
+    # …and the resume cursor (last_seq=2, past the barrier) must not
+    # have moved the server's compaction floor: only applied_seq may
+    assert a._ack_seq.get(b.addr, 0) <= 1
+
+
+def test_get_log_without_wal_answers_walkable_horizon(tmp_path):
+    """A server with no WAL (or log shipping disabled) answers an empty
+    chunk whose horizon says "everything is pre-horizon" and opens a
+    classic walk — requesters degrade gracefully, nothing stalls."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    a = _mk(transport, clock, "nw_a")  # no wal_dir
+    b = _mk(transport, clock, "nw_b")
+    for i in range(5):
+        a.mutate("add", [i, i])
+    transport.send(a.addr, sync_proto.GetLogMsg(frm=b.addr, to=a.addr, last_seq=0))
+    for m in transport.drain(a.addr):
+        a.handle(m)
+    msgs = transport.drain(b.addr)
+    chunk = next(m for m in msgs if isinstance(m, sync_proto.LogChunkMsg))
+    assert chunk.slices == [] and not chunk.more and chunk.horizon == a._seq
+    assert any(isinstance(m, sync_proto.DiffMsg) for m in msgs)  # the walk
+    for m in msgs:
+        b.handle(m)
+    _drive(transport, [a, b])
+    assert b.read() == a.read()
+
+
+def test_chunked_stream_is_requester_paced(tmp_path):
+    """A lag wider than the chunk row budget streams as multiple
+    bounded chunks, one in flight at a time (re-requested from each
+    ``seq_hi``), and the final chunk acks the round."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    a = _mk(transport, clock, "ch_a", tmp_path / "a", catchup_chunk_rows=8)
+    b = _mk(transport, clock, "ch_b")
+    a.set_neighbours([b])
+    transport.pump()
+    a.mutate("add", ["prime", 0])
+    a.sync_to_all()
+    transport.pump()
+    assert b._applied_seq.get(a.addr) == 1
+
+    for i in range(40):  # touches well over 8 distinct buckets
+        a.mutate("add", [i, i])
+    a.sync_to_all()
+    _lose_inflight(transport, b)
+    time.sleep(0.02)
+    a.sync_to_all()
+    kinds = []
+    for _ in range(24):
+        for m in transport.drain(b.addr):
+            kinds.append(type(m).__name__)
+            b.handle(m)
+        for m in transport.drain(a.addr):
+            kinds.append(type(m).__name__)
+            a.handle(m)
+    assert b.read() == a.read()
+    n_chunks = kinds.count("LogChunkMsg")
+    assert n_chunks > 1  # genuinely streamed
+    assert b.stats()["catchup"]["chunks_applied"] == n_chunks
+    assert not a._outstanding  # completion ack cleared the slot
+
+
+def test_down_mid_stream_leaves_receiver_consistent(tmp_path):
+    """The server dies between chunks: the receiver keeps every fully
+    applied chunk (idempotent merges), clears the stream, and a later
+    rejoin resumes from the advanced watermark without re-walking."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    # eager_deltas off: the catch-up stream is the ONLY carrier, so the
+    # resumption after the crash is observable (a restarted server's
+    # reset push cursors would otherwise re-cover the lag by themselves)
+    a = _mk(transport, clock, "dn_a", tmp_path / "a",
+            catchup_chunk_rows=8, eager_deltas=False)
+    b = _mk(transport, clock, "dn_b")
+    a.set_neighbours([b])
+    b.set_neighbours([a])  # b monitors a → Down(a) is delivered to b
+    transport.pump()
+    a.mutate("add", ["prime", 0])
+    a.sync_to_all()
+    transport.pump()
+
+    for i in range(40):
+        a.mutate("add", [i, i])
+    a.sync_to_all()
+    _lose_inflight(transport, b)
+    time.sleep(0.02)
+    a.sync_to_all()
+    # deliver the opener and exactly ONE chunk round trip
+    for m in _lose_inflight(transport, b):
+        b.handle(m)  # opener (+ any stray) → b requests
+    for m in transport.drain(a.addr):
+        a.handle(m)  # a serves chunk 1
+    chunk1 = [m for m in transport.drain(b.addr) if isinstance(m, sync_proto.LogChunkMsg)]
+    assert len(chunk1) == 1 and chunk1[0].more
+    b.handle(chunk1[0])  # applied; next request now queued at a
+    applied_before = b.stats()["catchup"]["chunks_applied"]
+    watermark = b._applied_seq.get(a.addr)
+    assert watermark == chunk1[0].seq_hi
+
+    a.crash()  # the server dies mid-stream; Down(a) reaches b
+    b.process_pending()
+    assert b.stats()["catchup"]["in_flight"] == 0  # stream aborted
+    # every applied chunk was an ordinary idempotent merge: the partial
+    # read is a consistent subset of what the writer actually wrote
+    written = {i: i for i in range(40)} | {"prime": 0}
+    assert set(b.read().items()) <= set(written.items())
+    assert b._applied_seq.get(a.addr) == watermark  # stands at last chunk
+
+    # the server rehydrates (same wal_dir) and the stream resumes from
+    # the watermark — no pre-watermark rows are re-requested
+    a2 = _mk(transport, clock, "dn_a", tmp_path / "a",
+             catchup_chunk_rows=8, eager_deltas=False)
+    a2.set_neighbours([b])
+    time.sleep(0.02)
+    a2.sync_to_all()
+    _drive(transport, [a2, b])
+    assert b.read() == a2.read()
+    assert b.stats()["catchup"]["chunks_applied"] > applied_before
+
+
+def test_watermarks_survive_restart_via_snapshot(tmp_path):
+    """peer_seqs ride compaction snapshots: a restarted replica resumes
+    log-shipped catch-up from its persisted watermark instead of
+    re-requesting history from zero."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    a = _mk(transport, clock, "sn_a", tmp_path / "a")
+    b = _mk(transport, clock, "sn_b", tmp_path / "b")
+    a.set_neighbours([b])
+    transport.pump()
+    for i in range(6):
+        a.mutate("add", [i, i])
+    a.sync_to_all()
+    transport.pump()
+    assert b._applied_seq.get(a.addr) == 6
+    b.checkpoint()  # snapshot carries the watermark
+    b.crash()
+
+    b2 = _mk(transport, clock, "sn_b", tmp_path / "b")
+    assert b2._applied_seq.get(a.addr) == 6
+    assert b2.read() == a.read()
+
+
+def test_catchup_telemetry_and_stats(tmp_path):
+    transport = LocalTransport()
+    clock = LogicalClock()
+    a = _mk(transport, clock, "tl_a", tmp_path / "a")
+    b = _mk(transport, clock, "tl_b")
+    a.set_neighbours([b])
+    transport.pump()
+    a.mutate("add", ["prime", 0])
+    a.sync_to_all()
+    transport.pump()
+
+    events = []
+    handler = lambda e, meas, meta: events.append((e, dict(meas), dict(meta)))
+    telemetry.attach(telemetry.CATCHUP_CHUNK, handler)
+    telemetry.attach(telemetry.CATCHUP_DONE, handler)
+    try:
+        for i in range(12):
+            a.mutate("add", [i, i])
+        a.sync_to_all()
+        _lose_inflight(transport, b)
+        time.sleep(0.02)
+        a.sync_to_all()
+        _drive(transport, [a, b])
+    finally:
+        telemetry.detach(telemetry.CATCHUP_CHUNK, handler)
+        telemetry.detach(telemetry.CATCHUP_DONE, handler)
+    assert b.read() == a.read()
+    roles = {m.get("role") for e, _meas, m in events if e == telemetry.CATCHUP_CHUNK}
+    assert roles == {"server", "client"}
+    done = [meas for e, meas, _m in events if e == telemetry.CATCHUP_DONE]
+    assert len(done) == 1 and done[0]["chunks"] == 1
+    assert done[0]["duration_s"] >= 0 and done[0]["horizon_fallback"] == 0
+    st = b.stats()["catchup"]
+    assert st["chunks_applied"] == 1 and st["rows_applied"] > 0
+    assert st["last_duration_s"] >= 0 and st["in_flight"] == 0
+
+
+def test_log_chunk_roundtrips_over_tcp():
+    """Catch-up frames are ordinary transport messages: a LogChunkMsg
+    with numpy slice bodies survives the TCP frame path (including the
+    big-array side channel) byte-for-byte."""
+    tcp = pytest.importorskip("delta_crdt_ex_tpu.runtime.tcp_transport")
+    t1 = tcp.TcpTransport()
+    t2 = tcp.TcpTransport()
+    try:
+        t2.register("sink", None)
+        arrays = {
+            "rows": np.arange(8, dtype=np.int32),
+            "key": np.arange(64, dtype=np.uint64).reshape(8, 8),
+        }
+        chunk = sync_proto.LogChunkMsg(
+            frm="src", to="sink", seq_lo=3, seq_hi=9, more=True, horizon=None,
+            slices=[{"buckets": np.arange(8, dtype=np.int64),
+                     "arrays": arrays, "payloads": {(1, 2, 3): ("k", "v")}}],
+        )
+        get = sync_proto.GetLogMsg(frm="src", to="sink", last_seq=3)
+        assert t1.send(("sink", t2.endpoint), get)
+        assert t1.send(("sink", t2.endpoint), chunk)
+        got = []
+        deadline = time.monotonic() + 5
+        while len(got) < 2 and time.monotonic() < deadline:
+            got += t2.drain("sink")
+            time.sleep(0.01)
+        assert [type(m).__name__ for m in got] == ["GetLogMsg", "LogChunkMsg"]
+        assert got[0].last_seq == 3
+        rt = got[1]
+        assert (rt.seq_lo, rt.seq_hi, rt.more, rt.horizon) == (3, 9, True, None)
+        assert np.array_equal(rt.slices[0]["arrays"]["key"], arrays["key"])
+        assert rt.slices[0]["payloads"] == {(1, 2, 3): ("k", "v")}
+    finally:
+        t1.close()
+        t2.close()
