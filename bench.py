@@ -766,7 +766,19 @@ def bench_catchup():
     }
     MAX_ROUNDS = 400
 
-    def build_universe(tag, mode, log_shipping):
+    # past_horizon: the writer's checkpoint compacts up to the
+    # membership-retain bound, so the receiver's watermark lands BELOW
+    # the horizon with a retained suffix of ~7/8 of the lag — the
+    # realistic rejoin shape under membership-gated compaction (a
+    # monitored peer's records are retained up to the bound). The
+    # receiver must then choose: suffix chunks + prefix walk, or pure
+    # walk. With the suffix dominating (ratio 7 >= the replica's
+    # catchup_suffix_ratio 4) it streams the suffix and walks only the
+    # short prefix.
+    def lag_records(lag_ops):
+        return lag_ops // 8 + (lag_ops // 8 + 3) // 4  # batches + removes
+
+    def build_universe(tag, mode, log_shipping, lag_ops):
         """One isolated (transport, writer, receiver) world per mode:
         fixed node ids and a fresh logical clock make the two writers
         bit-identical given the identical script, so the receivers'
@@ -780,13 +792,25 @@ def bench_catchup():
             capacity=(1 << depth) * 8, tree_depth=depth,
             sync_timeout=0.001, max_sync_size=max_sync, **kw,
         )
+        if tag == "past_horizon":
+            compaction = dict(
+                membership_compaction=True,
+                membership_retain=lag_records(lag_ops) * 7 // 8,
+                # fine-grained segments: compaction reclaims whole
+                # segments, so the horizon must be able to land mid-lag
+                segment_bytes=4 << 10,
+            )
+        else:
+            compaction = dict(
+                membership_compaction=False,
+                # realistic rolling segments: the range cursor then SKIPS
+                # pre-watermark segments by their start_seq instead of
+                # rescanning the whole history from one giant segment
+                segment_bytes=64 << 10,
+            )
         a = mk(
             f"cu_w_{tag}_{mode}", node_id=111, wal_dir=root, fsync_mode="none",
-            compact_every=10**9, membership_compaction=False,
-            # realistic rolling segments: the range cursor then SKIPS
-            # pre-watermark segments by their start_seq instead of
-            # rescanning the whole history from one giant segment
-            segment_bytes=64 << 10,
+            compact_every=10**9, **compaction,
         )
         b = mk(f"cu_r_{tag}_{mode}", node_id=777, log_shipping=log_shipping)
         return root, transport, a, b
@@ -834,7 +858,7 @@ def bench_catchup():
         }
 
     def run_mode(tag, mode, log_shipping, lag_ops):
-        root, transport, a, b = build_universe(tag, mode, log_shipping)
+        root, transport, a, b = build_universe(tag, mode, log_shipping, lag_ops)
         try:
             # prime: converge (walk mode needs several truncated rounds)
             # and seed the receiver's watermark
@@ -860,14 +884,28 @@ def bench_catchup():
             a.sync_to_all()
             transport.drain(b.addr)  # partition: slices lost in flight
             if tag == "past_horizon":
-                # the writer compacts past the receiver's floor: the log
-                # can only serve the retained suffix, the prefix must walk
+                # the writer compacts past the receiver's floor (up to
+                # the membership-retain bound): the log can only serve
+                # the retained suffix, the prefix must walk — and the
+                # retained suffix must DOMINATE the prefix, or the peer
+                # (correctly) skips the chunks and this tag would
+                # measure walk-vs-walk
                 a.checkpoint()
-                assert a.stats()["wal"]["horizon"] > b._applied_seq.get(a.addr, 0)
+                horizon = a.stats()["wal"]["horizon"]
+                w = b._applied_seq.get(a.addr, 0)
+                assert horizon > w, "past_horizon: lag not past the horizon"
+                assert a._seq - horizon >= b.catchup_suffix_ratio * (horizon - w), (
+                    f"past_horizon: retained suffix {a._seq - horizon} does "
+                    f"not dominate prefix {horizon - w}"
+                )
             time.sleep(0.002)  # expire the in-flight sync slot
 
             # reconnect: the measured quantity
+            chunks0 = b.stats()["catchup"]["chunks_applied"]
             res = drive_until_acked(transport, a, b, f"{tag}/{mode}", timed=True)
+            res["chunks_applied_reconnect"] = (
+                b.stats()["catchup"]["chunks_applied"] - chunks0
+            )
             assert b.read() == a.read()
             return res, a, b
         finally:
@@ -931,6 +969,18 @@ def bench_catchup():
         assert r["log_shipping"]["wall_s"] < r["digest_walk"]["wall_s"], (
             f"{tag}: log shipping must beat the walk on wall time"
         )
+    # ROADMAP follow-up (a): past the horizon the peer either streams a
+    # DOMINANT retained suffix (this tag's shape — chunks must flow and
+    # win rounds) or skips the chunks for the pure walk; never the
+    # measured-0.8x chunks-plus-walk-on-everything shape
+    ph = results["past_horizon"]
+    assert ph["log_shipping"]["chunks_applied_reconnect"] > 0, (
+        "past_horizon: dominant suffix must engage the clamped stream"
+    )
+    assert ph["round_speedup"] >= 1.0, (
+        f"past_horizon: rounds ratio {ph['round_speedup']} < 1.0 — the "
+        f"suffix-dominance mode decision regressed"
+    )
     mid = results["mid_log"]
     _emit({
         "metric": "catchup_logship_round_speedup" + ("_smoke" if SMOKE else ""),

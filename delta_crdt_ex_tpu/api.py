@@ -88,11 +88,14 @@ def start_link(crdt_module=AWLWWMap, *, threaded: bool = True, **opts) -> Replic
     re-seeing a neighbour requests that peer's delta-log suffix past
     its applied watermark (``GetLogMsg``) instead of paying the full
     digest walk; the server answers bounded ``LogChunkMsg`` runs of
-    full-row slices that merge through the grouped-ingest path, and a
-    request below the log's compaction horizon falls back to the walk
-    only for the pre-horizon prefix. Knobs: ``log_shipping``,
-    ``catchup_chunk_rows``; observability under
-    ``Replica.stats()["catchup"]``.
+    full-row slices that merge through the grouped-ingest path. Past
+    the compaction horizon the peer weighs the servable suffix against
+    the walk-bound prefix: a dominant suffix streams as a
+    horizon-clamped chunk run (only the prefix walks), a small one
+    skips the chunks entirely — the walk must heal it anyway. Knobs:
+    ``log_shipping``, ``catchup_chunk_rows``, ``catchup_suffix_ratio``
+    (engage the clamped stream when suffix ≥ ratio × prefix, default
+    4); observability under ``Replica.stats()["catchup"]``.
     """
     opts.setdefault("sync_interval", DEFAULT_SYNC_INTERVAL)
     opts.setdefault("max_sync_size", DEFAULT_MAX_SYNC_SIZE)

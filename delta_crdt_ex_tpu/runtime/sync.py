@@ -74,9 +74,15 @@ class DiffMsg:
     #: compares its applied watermark against it to decide the round's
     #: mode: watermark within the horizon → answer ``GetLogMsg`` (the
     #: log suffix IS the divergence, one streamed replay instead of the
-    #: level walk); below it → classic ping-pong. The decision rides the
-    #: opener so data keeps flowing originator → peer only, exactly like
-    #: the ``GetDiffMsg`` leaf fetch.
+    #: level walk); below it the peer weighs the servable suffix
+    #: ``seq − log_horizon`` against the walk-bound prefix
+    #: ``log_horizon − watermark`` — a dominant suffix (≥ the replica's
+    #: ``catchup_suffix_ratio``) still streams as a horizon-clamped
+    #: chunk run with only the prefix walking, anything less takes the
+    #: classic ping-pong outright (the walk heals everything it finds,
+    #: so chunks on top of a comparable walk are pure extra rounds).
+    #: The decision rides the opener so data keeps flowing originator →
+    #: peer only, exactly like the ``GetDiffMsg`` leaf fetch.
     log_horizon: int | None = None
 
 

@@ -892,3 +892,775 @@ def test_e2e_real_tree_clean_via_engine():
     assert new == []
     # the pallas probe carries exactly one justified allow
     assert any(f.path.endswith("ops/pallas_tree.py") for f in allowed)
+
+
+# ----------------------------------------------------------------------
+# WIRE001–WIRE005 — wire-protocol drift (fixtures)
+
+
+WIRE_PKG = {
+    "runtime/sync.py": """
+    import dataclasses
+
+
+    @dataclasses.dataclass
+    class PingMsg:
+        frm: str
+        seq: int
+
+
+    @dataclasses.dataclass
+    class PongMsg:
+        frm: str
+        seq: int
+    """,
+    "runtime/node.py": """
+    from fixpkg.runtime import sync
+
+
+    class Node:
+        def handle(self, msg):
+            if isinstance(msg, sync.PingMsg):
+                pass
+            elif isinstance(msg, sync.PongMsg):
+                pass
+    """,
+}
+
+
+def test_wire_complete_protocol_clean(tmp_path):
+    pkg = make_pkg(tmp_path, WIRE_PKG)
+    assert lint(pkg) == []
+
+
+def test_wire_unhandled_message_flagged(tmp_path):
+    mods = dict(WIRE_PKG)
+    mods["runtime/sync.py"] += (
+        "\n\n    @dataclasses.dataclass\n    class LostMsg:\n        frm: str\n"
+    )
+    pkg = make_pkg(tmp_path, mods)
+    found = lint(pkg)
+    assert rules_of(found) == {"WIRE001"}
+    assert "LostMsg" in found[0].message
+
+
+def test_wire_duplicate_and_ghost_arms_flagged(tmp_path):
+    mods = dict(WIRE_PKG)
+    mods["runtime/node.py"] = """
+    from fixpkg.runtime import sync
+
+
+    class Node:
+        def handle(self, msg):
+            if isinstance(msg, sync.PingMsg):
+                pass
+            elif isinstance(msg, sync.PongMsg):
+                pass
+            elif isinstance(msg, sync.PingMsg):
+                pass
+            elif isinstance(msg, sync.GhostMsg):
+                pass
+    """
+    pkg = make_pkg(tmp_path, mods)
+    found = lint(pkg)
+    assert rules_of(found) == {"WIRE002"}
+    msgs = " | ".join(f.message for f in found)
+    assert "already handled" in msgs and "missing" in msgs
+
+
+def test_wire_unserializable_field_flagged(tmp_path):
+    mods = dict(WIRE_PKG)
+    mods["runtime/sync.py"] = """
+    import dataclasses
+    import threading
+    from typing import Callable
+
+
+    @dataclasses.dataclass
+    class PingMsg:
+        frm: str
+        notify: Callable
+
+
+    @dataclasses.dataclass
+    class PongMsg:
+        frm: str
+        gate: threading.Lock
+    """
+    pkg = make_pkg(tmp_path, mods)
+    found = [f for f in lint(pkg) if f.rule == "WIRE003"]
+    assert len(found) == 2
+    assert "Callable" in found[0].message and "Lock" in found[1].message
+
+
+def test_wire_frame_kind_sent_but_not_decoded(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "runtime/codec.py": """
+            _MSG = 0
+            _PING = 1
+            _LOST = 2
+
+
+            def _send_frame(sock, kind, payload):
+                sock.sendall(bytes([kind]) + payload)
+
+
+            def client(sock):
+                _send_frame(sock, _MSG, b"x")
+                _send_frame(sock, _PING, b"")
+                _send_frame(sock, _LOST, b"?")
+
+
+            def serve(sock, kind, payload):
+                if kind == _MSG:
+                    return payload
+                elif kind == _PING:
+                    return b"pong"
+            """,
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"WIRE004"}
+    assert "_LOST" in found[0].message
+
+
+def test_wire_manifest_drift_flagged(tmp_path):
+    from tools.crdtlint.rules.wire import write_manifest
+
+    pkg = make_pkg(tmp_path, WIRE_PKG)
+    manifest = tmp_path / "manifest.json"
+    # recorded manifest: PingMsg with the OLD field list, PongMsg absent
+    write_manifest(manifest, {
+        "fixpkg": {
+            "module": "fixpkg/runtime/sync.py",
+            "messages": {
+                "PingMsg": {"fields": [["frm", "str"]], "sha256": "stale"},
+                "GoneMsg": {"fields": [], "sha256": "x"},
+            },
+        },
+    })
+    found = [
+        f for f in lint(pkg, manifest=manifest) if f.rule == "WIRE005"
+    ]
+    msgs = " | ".join(f.message for f in found)
+    assert "PingMsg" in msgs and "drifted" in msgs        # hash mismatch
+    assert "PongMsg" in msgs and "not in the protocol" in msgs
+    assert "GoneMsg" in msgs and "no longer defined" in msgs
+
+
+def test_wire_manifest_in_sync_clean(tmp_path):
+    from tools.crdtlint.engine import Project
+    from tools.crdtlint.rules.wire import compute_manifest, write_manifest
+
+    pkg = make_pkg(tmp_path, WIRE_PKG)
+    manifest = tmp_path / "manifest.json"
+    write_manifest(manifest, {"fixpkg": compute_manifest(Project(pkg))})
+    assert lint(pkg, manifest=manifest) == []
+
+
+# ----------------------------------------------------------------------
+# LOCK002 / LOCK003 — lock order + blocking under lock (fixtures)
+
+
+def test_lockorder_inverted_pair_flagged(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": """
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def forward(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def backward(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            """,
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"LOCK002"}
+    assert "deadlock" in found[0].message
+
+
+def test_lockorder_consistent_order_clean(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": """
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            pass
+            """,
+        },
+    )
+    assert lint(pkg) == []
+
+
+def test_lockorder_three_lock_rotation_cycle_flagged(tmp_path):
+    # no inverted PAIR anywhere — the deadlock is the 3-cycle a->b->c->a
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": """
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                    self._c = threading.Lock()
+
+                def ab(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def bc(self):
+                    with self._b:
+                        with self._c:
+                            pass
+
+                def ca(self):
+                    with self._c:
+                        with self._a:
+                            pass
+            """,
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"LOCK002"}
+    assert "_a" in found[0].message and "_c" in found[0].message
+
+
+def test_lockorder_interprocedural_held_state_edge(tmp_path):
+    # the second lock is taken in a helper that is only ever CALLED with
+    # the first held — the edge must come from the propagated entry
+    # state, not the helper's lexical context
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": """
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def forward(self):
+                    with self._a:
+                        self._grab_b()
+
+                def _grab_b(self):
+                    with self._b:
+                        pass
+
+                def backward(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            """,
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"LOCK002"}
+
+
+def test_lockorder_reentrant_rlock_not_a_cycle(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": """
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                    self._items = []
+
+                def outer(self):
+                    with self._lock:
+                        self._inner()
+
+                def _inner(self):
+                    with self._lock:
+                        self._items.append(1)
+            """,
+        },
+    )
+    assert lint(pkg) == []
+
+
+def test_blocking_under_lock_flagged(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": """
+            import os
+            import time
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._fd = 3
+
+                def slow(self):
+                    with self._lock:
+                        time.sleep(1.0)
+
+                def sync(self):
+                    with self._lock:
+                        os.fsync(self._fd)
+
+                def fine(self):
+                    time.sleep(1.0)
+            """,
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"LOCK003"}
+    assert len(found) == 2  # slow() + sync(); fine() holds nothing
+
+
+def test_blocking_via_constructed_member_flagged(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "wal.py": """
+            import os
+
+
+            class Wal:
+                def __init__(self, fd):
+                    self._fd = fd
+
+                def commit(self):
+                    self._write_out()
+
+                def _write_out(self):
+                    os.fsync(self._fd)
+            """,
+            "rep.py": """
+            import threading
+
+            from fixpkg.wal import Wal
+
+
+            class Rep:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._wal = Wal(3)
+
+                def mutate(self):
+                    with self._lock:
+                        self._wal.commit()
+            """,
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"LOCK003"}
+    assert "via Wal.commit" in found[0].message
+    assert found[0].path.endswith("rep.py")
+
+
+def test_blocking_via_module_import_constructed_member(tmp_path):
+    # `self._wal = wal.Wal(...)` — constructor through a MODULE import
+    # must resolve like the from-import form
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "wal.py": """
+            import os
+
+
+            class Wal:
+                def __init__(self, fd):
+                    self._fd = fd
+
+                def commit(self):
+                    os.fsync(self._fd)
+            """,
+            "rep.py": """
+            import threading
+
+            from fixpkg import wal
+
+
+            class Rep:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._wal = wal.Wal(3)
+
+                def mutate(self):
+                    with self._lock:
+                        self._wal.commit()
+            """,
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"LOCK003"}
+    assert "via Wal.commit" in found[0].message
+
+
+def test_wire_malformed_manifest_is_a_finding_not_a_crash(tmp_path):
+    pkg = make_pkg(tmp_path, WIRE_PKG)
+    manifest = tmp_path / "manifest.json"
+    manifest.write_text('{"version": 1, "packages": null}\n')
+    found = lint(pkg, manifest=manifest)
+    assert rules_of(found) == {"WIRE005"}
+    assert "malformed" in found[0].message
+
+
+def test_blocking_thread_join_receiver_typed(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": """
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._t = threading.Thread(target=self._run)
+
+                def _run(self):
+                    pass
+
+                def stop_bad(self):
+                    with self._lock:
+                        self._t.join()
+
+                def stop_good(self):
+                    self._t.join()
+
+                def strings_fine(self):
+                    with self._lock:
+                        return ", ".join(["a", "b"])
+            """,
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"LOCK003"}
+    assert len(found) == 1 and "Thread.join" in found[0].message
+
+
+# ----------------------------------------------------------------------
+# WAL001 / WAL002 — record-kind exhaustiveness (fixtures)
+
+
+WAL_PKG = {
+    "wal.py": """
+    class Log:
+        def append_batch(self, seq, ops):
+            self._stage({"kind": "batch", "seq": seq, "ops": ops})
+
+        def append_slice(self, seq, arrays):
+            self._stage({"kind": "entries", "seq": seq, "arrays": arrays})
+
+        def _stage(self, rec):
+            pass
+    """,
+    "rep.py": """
+    class Rep:
+        def _replay(self, records):
+            for rec in records:
+                if rec["kind"] == "batch":
+                    pass
+                elif rec["kind"] == "entries":
+                    pass
+
+        def _scan_log_rows(self, records):
+            for rec in records:
+                kind = rec.get("kind")
+                if kind == "batch":
+                    pass
+                elif kind == "entries":
+                    pass
+    """,
+}
+
+
+def test_wal_kinds_covered_clean(tmp_path):
+    pkg = make_pkg(tmp_path, WAL_PKG)
+    assert lint(pkg) == []
+
+
+def test_wal_new_kind_must_reach_both_dispatchers(tmp_path):
+    mods = dict(WAL_PKG)
+    mods["wal.py"] += (
+        "\n"
+        "        def append_clear(self, seq):\n"
+        '            self._stage({"kind": "clear", "seq": seq})\n'
+    )
+    pkg = make_pkg(tmp_path, mods)
+    found = lint(pkg)
+    assert rules_of(found) == {"WAL001", "WAL002"}
+    assert all("'clear'" in f.message for f in found)
+
+
+def test_wal_membership_classification_counts(tmp_path):
+    # `kind in ("a", "b")` is an explicit classification, same as ==
+    mods = dict(WAL_PKG)
+    mods["wal.py"] += (
+        "\n"
+        "        def append_clear(self, seq):\n"
+        '            self._stage({"kind": "clear", "seq": seq})\n'
+    )
+    mods["rep.py"] = """
+    class Rep:
+        def _replay(self, records):
+            for rec in records:
+                if rec["kind"] in ("batch", "entries", "clear"):
+                    pass
+
+        def _scan_log_rows(self, records):
+            for rec in records:
+                kind = rec.get("kind")
+                if kind in ("clear",):
+                    pass  # explicit barrier
+                elif kind == "batch":
+                    pass
+                elif kind == "entries":
+                    pass
+    """
+    pkg = make_pkg(tmp_path, mods)
+    assert lint(pkg) == []
+
+
+def test_wal_missing_replay_dispatcher_flagged(tmp_path):
+    pkg = make_pkg(tmp_path, {"wal.py": WAL_PKG["wal.py"]})
+    found = lint(pkg)
+    assert rules_of(found) == {"WAL001", "WAL002"}
+    assert any("no recovery replay" in f.message for f in found)
+
+
+# ----------------------------------------------------------------------
+# SUPPRESS001 / SUPPRESS002 — stale-suppression hygiene
+
+
+def test_stale_allow_comment_flagged(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/k.py": """
+            import jax
+
+            def probe(f, x):
+                # crdtlint: allow[host-sync] probe must synchronise
+                jax.jit(f)(x).block_until_ready()
+                y = x  # crdtlint: allow[donation] nothing donated here
+                return f, y
+            """,
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"SUPPRESS001"}
+    assert "allow[donation]" in found[0].message
+
+
+def test_stale_baseline_entry_flagged(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {"box.py": LOCKED_CLASS.format(body="size(self):\n            return len(self._items)")},
+    )
+    baseline = {
+        ("fixpkg/box.py", "LOCK001", "long-gone finding message"): 1,
+    }
+    found = [f for f in lint(pkg, baseline=baseline) if f.rule == "SUPPRESS002"]
+    assert len(found) == 1
+    assert "long-gone finding message" in found[0].message
+
+
+def test_hygiene_skipped_under_select(tmp_path):
+    # a --select run cannot distinguish stale from not-run: no SUPPRESS
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/k.py": """
+            def f(x):
+                return x  # crdtlint: allow[purity] speculative
+            """,
+        },
+    )
+    assert lint(pkg, select={"LOCK001"}) == []
+    assert rules_of(lint(pkg)) == {"SUPPRESS001"}
+
+
+def test_multiline_justification_comment_projects_past_continuation(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/k.py": """
+            import jax
+
+            def probe(f, x):
+                # crdtlint: allow[host-sync] the justification of this
+                # probe spans several comment lines before the call
+                jax.jit(f)(x).block_until_ready()
+                return f
+            """,
+        },
+    )
+    new, _baselined, allowed = run_lint([pkg])
+    assert new == [] and len(allowed) == 1
+
+
+# ----------------------------------------------------------------------
+# mutation tests — every new rule family proves it turns the gate red
+# on the REAL tree (engine overlay, working tree untouched)
+
+
+def _overlay_lint(rel: str, mutate) -> list[Finding]:
+    src = (REPO_ROOT / rel).read_text()
+    new, _, _ = run_lint([REPO_ROOT / PKG], overlay={rel: mutate(src)})
+    return new
+
+
+def test_mutation_deleted_dispatch_arm_is_caught():
+    """Acceptance: deleting a dispatch arm in replica.py turns the gate
+    red (WIRE001: the message is no longer handled anywhere)."""
+    rel = f"{PKG}/runtime/replica.py"
+    arm = (
+        "            elif isinstance(msg, sync_proto.GetLogMsg):\n"
+        "                self._handle_get_log(msg)\n"
+    )
+    assert arm in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(rel, lambda s: s.replace(arm, ""))
+    assert any(
+        f.rule == "WIRE001" and "GetLogMsg" in f.message for f in new
+    )
+
+
+def test_mutation_unserializable_ackmsg_field_is_caught():
+    """Acceptance: adding an unserializable field to AckMsg turns the
+    gate red (WIRE003 type check + WIRE005 manifest drift)."""
+    rel = f"{PKG}/runtime/sync.py"
+    anchor = "    clear_addr: Hashable"
+    assert anchor in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel, lambda s: s.replace(anchor, anchor + "\n    waiter: 'threading.Event'")
+    )
+    assert any(f.rule == "WIRE003" and "AckMsg" in f.message for f in new)
+    assert any(f.rule == "WIRE005" and "AckMsg" in f.message for f in new)
+
+
+def test_mutation_reordered_wire_fields_is_caught():
+    """Acceptance: reordering DiffMsg fields without bumping the
+    manifest turns the gate red (WIRE005 — order is wire contract)."""
+    rel = f"{PKG}/runtime/sync.py"
+    src = (REPO_ROOT / rel).read_text()
+    a = "    originator: Hashable\n    frm: Hashable\n"
+    assert a in src
+    new = _overlay_lint(
+        rel, lambda s: s.replace(a, "    frm: Hashable\n    originator: Hashable\n", 1)
+    )
+    assert any(f.rule == "WIRE005" and "DiffMsg" in f.message for f in new)
+
+
+def test_mutation_undecoded_frame_kind_is_caught():
+    """A frame kind sent by the TCP codec without a receive-path decode
+    arm turns the gate red (WIRE004)."""
+    rel = f"{PKG}/runtime/tcp_transport.py"
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace("_MSGB = 5", "_MSGB = 5\n_TRACE = 7").replace(
+            '_send_frame(sock, _PING, b"")',
+            '_send_frame(sock, _TRACE, b"");  _send_frame(sock, _PING, b"")',
+            1,
+        ),
+    )
+    assert any(f.rule == "WIRE004" and "_TRACE" in f.message for f in new)
+
+
+def test_mutation_inverted_lock_pair_is_caught():
+    """Acceptance: an inverted lock-acquisition pair in replica.py turns
+    the gate red (LOCK002)."""
+    rel = f"{PKG}/runtime/replica.py"
+    probe = (
+        "\n"
+        "    def probe_setup(self):\n"
+        "        self._probe_lock = threading.Lock()\n"
+        "\n"
+        "    def probe_forward(self):\n"
+        "        with self._lock:\n"
+        "            with self._probe_lock:\n"
+        "                pass\n"
+        "\n"
+        "    def probe_backward(self):\n"
+        "        with self._probe_lock:\n"
+        "            with self._lock:\n"
+        "                pass\n"
+    )
+
+    def mutate(s: str) -> str:
+        cls_end = s.rindex("\n    def stop(self)")
+        tail_end = s.index("self.transport.unregister(self.name)", cls_end)
+        tail_end = s.index("\n", tail_end) + 1
+        return s[:tail_end] + probe + s[tail_end:]
+
+    new = _overlay_lint(rel, mutate)
+    assert any(f.rule == "LOCK002" for f in new)
+
+
+def test_mutation_invented_wal_kind_is_caught():
+    """Acceptance: a WAL record kind written by a producer without
+    replay/serving arms turns the gate red (WAL001 + WAL002)."""
+    rel = f"{PKG}/runtime/replica.py"
+    anchor = '"kind": "entries",'
+    src = (REPO_ROOT / rel).read_text()
+    assert anchor in src
+    new = _overlay_lint(
+        rel, lambda s: s.replace(anchor, '"kind": "tombstone",', 1)
+    )
+    assert any(f.rule == "WAL001" and "'tombstone'" in f.message for f in new)
+    assert any(f.rule == "WAL002" and "'tombstone'" in f.message for f in new)
+
+
+def test_mutation_stale_allow_is_caught():
+    """A freshly stale allow comment (rule fixed, comment left behind)
+    turns the gate red (SUPPRESS001)."""
+    rel = f"{PKG}/runtime/wal.py"
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(
+            "import dataclasses",
+            "import dataclasses  # crdtlint: allow[purity] speculative",
+            1,
+        ),
+    )
+    assert any(
+        f.rule == "SUPPRESS001" and f.path.endswith("runtime/wal.py")
+        for f in new
+    )

@@ -842,3 +842,118 @@ def test_log_chunk_roundtrips_over_tcp():
     finally:
         t1.close()
         t2.close()
+
+
+# ---------------------------------------------------------------------------
+# past-horizon mode decision (ROADMAP follow-up (a) / ISSUE 5 satellite)
+
+
+def _lagged_pair(tmp_path, transport, clock, **writer_opts):
+    """Prime a (writer, receiver) pair to watermark 4, then lag the
+    writer by ops 4..40 with the receiver partitioned."""
+    a = _mk(
+        transport, clock, "sx_a", tmp_path / "a",
+        segment_bytes=256, compact_every=10**9, **writer_opts,
+    )
+    b = _mk(transport, clock, "sx_b")
+    a.set_neighbours([b])
+    transport.pump()
+    for i in range(4):
+        a.mutate("add", [i, i])
+    a.sync_to_all()
+    transport.pump()
+    assert b._applied_seq.get(a.addr) == 4
+    for i in range(4, 40):
+        a.mutate("add", [i, i])
+    a.sync_to_all()
+    _lose_inflight(transport, b)
+    return a, b
+
+
+def test_past_horizon_dominant_suffix_streams_clamped_chunks(tmp_path):
+    """Past the horizon with a DOMINANT retained suffix (the
+    membership-retain shape), the peer answers the opener with a clamped
+    catch-up stream: the suffix ships as chunks, only the short prefix
+    heals by walk — and the walk floor prevents a re-request loop."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    a, b = _lagged_pair(
+        tmp_path, transport, clock,
+        membership_compaction=True, membership_retain=32,
+    )
+    a.checkpoint()  # reclaim to the retain bound: horizon lands mid-lag
+    horizon = a.stats()["wal"]["horizon"]
+    w = b._applied_seq.get(a.addr)
+    assert w < horizon < a._seq
+    assert a._seq - horizon >= b.catchup_suffix_ratio * (horizon - w)
+
+    time.sleep(0.02)
+    before = b.stats()["catchup"]["chunks_applied"]
+    a.sync_to_all()
+    _drive(transport, [a, b], rounds=30)
+    st = b.stats()["catchup"]
+    assert st["chunks_applied"] > before, "dominant suffix must stream"
+    assert st["horizon_fallbacks"] >= 1  # the stream was clamped
+    assert st["in_flight"] == 0
+
+    # the prefix healed by walk in the same exchange: full convergence,
+    # and the walk equality retired the per-peer walk floor
+    time.sleep(0.02)
+    a.sync_to_all()
+    _drive(transport, [a, b], rounds=30)
+    assert b.read() == a.read()
+    assert b._applied_seq.get(a.addr) == a._seq
+    assert b._catchup_walk_floor.get(a.addr) is None
+
+
+def test_past_horizon_small_suffix_skips_chunks_entirely(tmp_path):
+    """When compaction swallowed (nearly) the whole lag, the walk must
+    carry everything anyway — the peer skips the suffix chunks instead
+    of paying stream round trips on top of the walk (the measured ~0.8x
+    regression shape)."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    a, b = _lagged_pair(
+        tmp_path, transport, clock, membership_compaction=False,
+    )
+    a.checkpoint()  # membership gate off: reclaim to the snapshot seq
+    horizon = a.stats()["wal"]["horizon"]
+    w = b._applied_seq.get(a.addr)
+    assert w < horizon and a._seq - horizon == 0  # empty servable suffix
+
+    time.sleep(0.02)
+    before = b.stats()["catchup"]["chunks_applied"]
+    while b._applied_seq.get(a.addr) != a._seq:
+        a.sync_to_all()
+        moved = _drive(transport, [a, b], rounds=30)
+        assert moved, "no progress toward convergence"
+        time.sleep(0.02)
+    assert b.read() == a.read()
+    assert b.stats()["catchup"]["chunks_applied"] == before, (
+        "an empty suffix must not open a catch-up stream"
+    )
+
+
+def test_catchup_suffix_ratio_knob_gates_the_stream(tmp_path):
+    """The same dominant-suffix lag with an extreme ratio knob goes
+    straight to the walk — the mode decision is the knob, not a
+    hardcode."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    a, b = _lagged_pair(
+        tmp_path, transport, clock,
+        membership_compaction=True, membership_retain=32,
+    )
+    b.catchup_suffix_ratio = 10_000.0
+    a.checkpoint()
+    assert b._applied_seq.get(a.addr) < a.stats()["wal"]["horizon"]
+
+    time.sleep(0.02)
+    before = b.stats()["catchup"]["chunks_applied"]
+    while b._applied_seq.get(a.addr) != a._seq:
+        a.sync_to_all()
+        moved = _drive(transport, [a, b], rounds=30)
+        assert moved, "no progress toward convergence"
+        time.sleep(0.02)
+    assert b.read() == a.read()
+    assert b.stats()["catchup"]["chunks_applied"] == before

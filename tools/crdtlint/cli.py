@@ -5,6 +5,8 @@ Usage::
     python -m tools.crdtlint delta_crdt_ex_tpu            # lint, exit 1 on findings
     python -m tools.crdtlint delta_crdt_ex_tpu --write-baseline
     python -m tools.crdtlint delta_crdt_ex_tpu --baseline path.json
+    python -m tools.crdtlint delta_crdt_ex_tpu --format github   # CI annotations
+    python -m tools.crdtlint delta_crdt_ex_tpu --write-protocol-manifest
     python -m tools.crdtlint --list-rules
 
 Exit codes: 0 clean (or fully suppressed), 1 unsuppressed findings,
@@ -26,6 +28,11 @@ DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
 RULE_CATALOG = [
     ("LOCK001", "access to a lock-guarded self._* attribute on a path that can "
                 "run without the guarding lock held"),
+    ("LOCK002", "lock acquisition-order cycle across methods/classes — two "
+                "threads taking the locks in opposite orders deadlock"),
+    ("LOCK003", "blocking call (fsync, socket I/O, sleep, Thread.join, "
+                "Event.wait, block_until_ready, WAL segment roll) reachable "
+                "while a lock is held"),
     ("SYNC001", ".item()/.tolist()/int()/float()/np.asarray/device_get/"
                 "block_until_ready inside a function reachable from a "
                 "jax.jit / shard_map / pallas_call entry point"),
@@ -37,6 +44,26 @@ RULE_CATALOG = [
                 "nondeterministic joins diverge replica-to-replica"),
     ("DONATE001", "argument donated via donate_argnums/donate_argnames is read "
                   "again after the jitted call"),
+    ("WIRE001", "wire message dataclass with no isinstance arm in any "
+                "dispatch ladder — receivers raise on it"),
+    ("WIRE002", "dispatch ladder arm that can never fire (class renamed/"
+                "removed, or duplicated earlier in the ladder)"),
+    ("WIRE003", "wire message field whose annotated type is not "
+                "wire-serializable (plain data + numpy arrays only)"),
+    ("WIRE004", "frame kind sent by a codec module but never compared on a "
+                "receive path — peers drop it as unknown"),
+    ("WIRE005", "wire message fields drifted from the checked-in protocol "
+                "manifest (regenerate with --write-protocol-manifest after "
+                "reviewing mixed-version compat)"),
+    ("WAL001", "WAL record kind produced but missing a replay arm in the "
+               "recovery dispatcher — durable records silently skipped"),
+    ("WAL002", "WAL record kind produced without explicit serving "
+               "classification in the log-shipping scan — catch-up silently "
+               "degrades to the walk"),
+    ("SUPPRESS001", "stale allow[...] comment matching no finding (hygiene; "
+                    "not itself suppressible)"),
+    ("SUPPRESS002", "stale baseline entry matching no finding (hygiene; "
+                    "not itself suppressible)"),
 ]
 
 
@@ -77,10 +104,31 @@ def _main(argv: list[str] | None = None) -> int:
     )
     parser.add_argument(
         "--select", action="append", default=None, metavar="RULE",
-        help="only run the given rule id(s) (repeatable)",
+        help="only run the given rule id(s) (repeatable; disables the "
+        "stale-suppression hygiene pass, which needs a full run)",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    parser.add_argument(
+        "--format", choices=("text", "github"), default="text",
+        help="finding output format: plain text (default) or GitHub "
+        "Actions ::error annotations for CI logs",
+    )
+    parser.add_argument(
+        "--manifest", type=Path, default=None,
+        help="protocol manifest for the WIRE005 wire-compat lock "
+        "(default: the checked-in protocol_manifest.json)",
+    )
+    parser.add_argument(
+        "--write-protocol-manifest", action="store_true",
+        help="record the current wire-message field lists into the "
+        "protocol manifest and exit 0 (do this AFTER reviewing "
+        "mixed-version wire compat for any changed message)",
+    )
+    parser.add_argument(
+        "--no-hygiene", action="store_true",
+        help="skip the stale-suppression hygiene pass (SUPPRESS001/2)",
     )
     parser.add_argument(
         "-q", "--quiet", action="store_true",
@@ -125,12 +173,18 @@ def _main(argv: list[str] | None = None) -> int:
                 file=sys.stderr,
             )
             return 2
+    if args.write_protocol_manifest:
+        return _write_protocol_manifest(package_dirs, args.manifest)
+
     new, baselined, allowed = run_lint(
-        package_dirs, baseline=baseline, select=select
+        package_dirs, baseline=baseline, select=select,
+        manifest=args.manifest,
+        hygiene=not (args.no_hygiene or args.write_baseline),
     )
 
     if args.write_baseline:
-        entries = list(new)
+        # hygiene meta-findings must never be WRITTEN as accepted debt
+        entries = [f for f in new if not f.rule.startswith("SUPPRESS")]
         if select and baseline_path.exists():
             # a selective rewrite must not discard other rules' accepted
             # debt: carry over every baselined entry outside the selection
@@ -148,13 +202,54 @@ def _main(argv: list[str] | None = None) -> int:
         return 0
 
     for f in new:
-        print(f.render())
+        if args.format == "github":
+            # GitHub Actions workflow-command annotation: renders the
+            # finding inline on the PR diff from a plain CI log line
+            print(
+                f"::error file={f.path},line={max(f.line, 1)},"
+                f"title=crdtlint {f.rule}::{f.message}"
+            )
+        else:
+            print(f.render())
     if not args.quiet:
         print(
             f"crdtlint: {len(new)} finding(s) "
             f"({len(allowed)} allowed inline, {len(baselined)} baselined)"
         )
     return 1 if new else 0
+
+
+def _write_protocol_manifest(package_dirs: list[Path], manifest: Path | None) -> int:
+    from tools.crdtlint.engine import Project
+    from tools.crdtlint.rules.wire import (
+        DEFAULT_MANIFEST,
+        compute_manifest,
+        load_manifest,
+        write_manifest,
+    )
+
+    path = manifest or DEFAULT_MANIFEST
+    try:
+        packages = load_manifest(path).get("packages", {})
+    except (FileNotFoundError, ValueError, AttributeError):
+        packages = {}
+    if not isinstance(packages, dict):
+        packages = {}  # structurally mangled manifest: rebuild from scratch
+    wrote = []
+    for pkg in package_dirs:
+        project = Project(pkg)
+        stanza = compute_manifest(project)
+        if stanza is None:
+            print(
+                f"crdtlint: {pkg} defines no wire-message protocol module; "
+                f"nothing recorded", file=sys.stderr,
+            )
+            continue
+        packages[project.package_name] = stanza
+        wrote.append(project.package_name)
+    write_manifest(path, packages)
+    print(f"crdtlint: wrote protocol manifest for {wrote} to {path}")
+    return 0
 
 
 if __name__ == "__main__":

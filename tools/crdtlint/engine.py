@@ -26,7 +26,15 @@ FAMILY_TAGS = {
     "host-sync": "SYNC",
     "purity": "PURE",
     "donation": "DONATE",
+    "wire": "WIRE",
+    "wal": "WAL",
 }
+
+#: hygiene meta-rules (stale suppressions). They report on the
+#: suppression machinery itself, so they are deliberately NOT
+#: suppressible by allow comments or the baseline — the fix is always
+#: to delete the stale allow/entry (or regenerate the baseline).
+SUPPRESS_RULES = ("SUPPRESS001", "SUPPRESS002")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -46,6 +54,18 @@ class Finding:
         return f"{self.path}:{self.line} {self.rule} {self.message}"
 
 
+@dataclasses.dataclass
+class AllowRecord:
+    """One ``allow[...]`` comment: where it sits, which source lines it
+    covers, and whether any finding actually used it this run (the
+    stale-suppression hygiene check, SUPPRESS001)."""
+
+    comment_line: int
+    lines: frozenset
+    tags: frozenset
+    used: bool = False
+
+
 class ModuleInfo:
     """One parsed module: AST, raw source lines, allow-comment map, and
     the import table used to resolve cross-module references."""
@@ -57,7 +77,7 @@ class ModuleInfo:
         self.source = source
         self.tree = ast.parse(source, filename=str(path))
         self.lines = source.splitlines()
-        self.allows = self._scan_allows(self.lines)
+        self.allow_records = self._scan_allows(self.lines)
         # alias -> ("mod", modname) | ("sym", modname, symbol)
         self.imports: dict[str, tuple] = {}
         # top-level function/async defs by name (methods live under classes)
@@ -65,33 +85,40 @@ class ModuleInfo:
         self.classes: dict[str, ast.ClassDef] = {}
 
     @staticmethod
-    def _scan_allows(lines: list[str]) -> dict[int, set[str]]:
-        allows: dict[int, set[str]] = {}
+    def _scan_allows(lines: list[str]) -> list[AllowRecord]:
+        records: list[AllowRecord] = []
         for i, raw in enumerate(lines, start=1):
             m = _ALLOW_RE.search(raw)
             if not m:
                 continue
-            tags = {t.strip() for t in m.group(1).split(",") if t.strip()}
-            allows.setdefault(i, set()).update(tags)
-            # a pure-comment line annotates the next source line
+            tags = frozenset(t.strip() for t in m.group(1).split(",") if t.strip())
+            covered = {i}
             if raw.lstrip().startswith("#"):
-                allows.setdefault(i + 1, set()).update(tags)
-        return allows
+                # a pure-comment allow annotates the next SOURCE line:
+                # justification comments may continue over further
+                # comment lines, which must not eat the projection
+                j = i  # 0-based index of the line after line i
+                while j < len(lines) and lines[j].lstrip().startswith("#"):
+                    j += 1
+                covered.add(j + 1)
+            records.append(AllowRecord(i, frozenset(covered), tags))
+        return records
 
-    def allowed(self, line: int, rule: str) -> bool:
+    def match_allow(self, line: int, rule: str) -> AllowRecord | None:
         # only this line's tags: trailing comments register on their own
-        # line, comment-only lines were projected onto the next line at
-        # scan time — so an allow can never bleed onto a neighbouring
-        # statement's findings
-        tags = self.allows.get(line)
-        if not tags:
-            return False
-        if "all" in tags or rule in tags:
-            return True
-        return any(
-            (prefix := FAMILY_TAGS.get(tag)) and rule.startswith(prefix)
-            for tag in tags
-        )
+        # line, comment-only lines cover exactly the next line — so an
+        # allow can never bleed onto a neighbouring statement's findings
+        for rec in self.allow_records:
+            if line not in rec.lines:
+                continue
+            if "all" in rec.tags or rule in rec.tags:
+                return rec
+            if any(
+                (prefix := FAMILY_TAGS.get(tag)) and rule.startswith(prefix)
+                for tag in rec.tags
+            ):
+                return rec
+        return None
 
 
 def _dotted(node: ast.AST) -> str | None:
@@ -116,12 +143,16 @@ class Project:
     """
 
     def __init__(self, package_dir: Path, root: Path | None = None,
-                 overlay: dict[str, str] | None = None):
+                 overlay: dict[str, str] | None = None,
+                 manifest: Path | None = None):
         package_dir = package_dir.resolve()
         if not (package_dir / "__init__.py").exists():
             raise ValueError(f"{package_dir} is not a package (no __init__.py)")
         self.package_dir = package_dir
         self.package_name = package_dir.name
+        #: protocol manifest consulted by the WIRE005 wire-compat lock
+        #: (None -> the checked-in default next to the linter)
+        self.manifest_path = manifest
         self.root = (root or package_dir.parent).resolve()
         overlay = overlay or {}
         self.modules: dict[str, ModuleInfo] = {}
@@ -279,12 +310,21 @@ def run_lint(
     baseline: dict[tuple[str, str, str], int] | None = None,
     overlay: dict[str, str] | None = None,
     select: set[str] | None = None,
+    manifest: Path | None = None,
+    hygiene: bool = True,
 ) -> tuple[list[Finding], list[Finding], list[Finding]]:
     """Lint the given packages.
 
     Returns ``(new, baselined, allowed)``: findings not suppressed by
     anything, findings absorbed by the baseline, and findings silenced
     by inline allow comments.
+
+    With ``hygiene`` (and no ``select`` — a partial run cannot tell a
+    stale suppression from one whose rule simply didn't run), stale
+    suppressions are reported as findings in ``new``: SUPPRESS001 for an
+    ``allow[...]`` comment no finding used, SUPPRESS002 for a baseline
+    entry with leftover count. Neither is itself suppressible — the fix
+    is to delete the stale allow/entry (``--write-baseline`` prunes).
     """
     from tools.crdtlint.rules import ALL_RULES
 
@@ -292,22 +332,43 @@ def run_lint(
     baselined: list[Finding] = []
     allowed: list[Finding] = []
     remaining = dict(baseline or {})
+    hygiene = hygiene and select is None
     for pkg in package_dirs:
-        project = Project(Path(pkg), root=root, overlay=overlay)
+        project = Project(Path(pkg), root=root, overlay=overlay,
+                          manifest=manifest)
         findings: list[Finding] = []
         for rule_fn in ALL_RULES:
             findings.extend(rule_fn(project))
+        by_rel = {m.rel: m for m in project.modules.values()}
         for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
             if select and f.rule not in select:
                 continue
-            mod = next(
-                (m for m in project.modules.values() if m.rel == f.path), None
-            )
-            if mod is not None and mod.allowed(f.line, f.rule):
+            mod = by_rel.get(f.path)
+            rec = mod.match_allow(f.line, f.rule) if mod is not None else None
+            if rec is not None:
+                rec.used = True
                 allowed.append(f)
             elif remaining.get(f.fingerprint(), 0) > 0:
                 remaining[f.fingerprint()] -= 1
                 baselined.append(f)
             else:
                 new.append(f)
+        if hygiene:
+            for mod in project.modules.values():
+                for rec in mod.allow_records:
+                    if not rec.used:
+                        tags = ",".join(sorted(rec.tags))
+                        new.append(Finding(
+                            mod.rel, rec.comment_line, "SUPPRESS001",
+                            f"stale suppression: allow[{tags}] matches no "
+                            f"finding — delete the comment (or fix the tag)",
+                        ))
+    if hygiene:
+        for (path, rule, message), count in sorted(remaining.items()):
+            if count > 0:
+                new.append(Finding(
+                    path, 0, "SUPPRESS002",
+                    f"stale baseline entry ({rule}): {message!r} matches no "
+                    f"finding — regenerate with --write-baseline",
+                ))
     return new, baselined, allowed

@@ -75,13 +75,19 @@ def iter_function_defs(tree: ast.AST):
 
 
 from tools.crdtlint.rules.locks import check_lock_discipline
+from tools.crdtlint.rules.lockorder import check_lock_order
 from tools.crdtlint.rules.hostsync import check_host_sync
 from tools.crdtlint.rules.purity import check_purity
 from tools.crdtlint.rules.donation import check_donation
+from tools.crdtlint.rules.wire import check_wire
+from tools.crdtlint.rules.walkinds import check_wal_kinds
 
 ALL_RULES = [
     check_lock_discipline,
+    check_lock_order,
     check_host_sync,
     check_purity,
     check_donation,
+    check_wire,
+    check_wal_kinds,
 ]

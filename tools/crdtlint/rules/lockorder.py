@@ -1,0 +1,272 @@
+"""LOCK002/LOCK003 — lock-acquisition order and blocking-under-lock.
+
+Builds on LOCK001's class analysis (scans + interprocedural entry lock
+states) and extends it across classes through *constructed* member
+objects (``self._wal = WalLog(...)`` — the replica owns its WAL, so a
+``self._wal.commit()`` under the replica lock reaches ``os.fsync``
+inside :class:`WalLog`):
+
+- **LOCK002** — the acquisition-order graph has a cycle: some path
+  acquires lock B while holding A, another acquires A while holding B.
+  Two threads interleaving those paths deadlock; no test catches it
+  until the scheduler does. Reentrant re-acquisition of the SAME lock
+  (RLock) is not an ordering edge.
+- **LOCK003** — a call that can block the thread (``os.fsync``, socket
+  I/O, ``time.sleep``, ``Thread.join``, ``Event.wait``,
+  ``block_until_ready`` device sync, WAL segment roll) is reachable
+  while a lock is held. Every other thread contending on that lock —
+  the sync tick, mutators, reads — stalls for the blocking call's full
+  duration. Sites that block *by contract* (the WAL group-commit
+  durability point) carry ``allow[LOCK003]`` comments stating the why.
+
+Boundary (documented, deliberate): member classes are resolved only
+through direct constructor assignments. Injected collaborators (the
+replica's ``transport=`` parameter) are analysed in their own class
+context — their internal discipline is checked, the cross-object edge
+is not inferable statically.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.crdtlint.engine import Finding, ModuleInfo, Project
+from tools.crdtlint.rules.locks import (
+    INIT,
+    _ClassAnalysis,
+    analyse_units,
+)
+
+RULE_ORDER = "LOCK002"
+RULE_BLOCKING = "LOCK003"
+
+
+class _ClassInfo:
+    """One analysed class plus its per-method transitive summaries."""
+
+    def __init__(self, mod: ModuleInfo, node: ast.ClassDef):
+        self.mod = mod
+        self.node = node
+        self.cls = _ClassAnalysis(mod, node)
+        self.scans, self.entry_states = analyse_units(self.cls)
+        self._reach: dict[str, set[str]] = {}
+
+    def reachable(self, method: str) -> set[str]:
+        """Methods transitively reachable from ``method`` through
+        self-calls inside this class (including itself)."""
+        cached = self._reach.get(method)
+        if cached is not None:
+            return cached
+        seen: set[str] = set()
+        stack = [method]
+        while stack:
+            m = stack.pop()
+            if m in seen or m not in self.scans:
+                continue
+            seen.add(m)
+            stack.extend(e.callee for e in self.scans[m].edges)
+        self._reach[method] = seen
+        return seen
+
+    def blocking_summary(self, method: str) -> list[tuple[str, str, int]]:
+        """``(what, via_method, line)`` blocking events reachable from
+        calling ``method`` on an instance of this class."""
+        out = []
+        for m in sorted(self.reachable(method)):
+            for b in self.scans[m].blocking:
+                out.append((b.what, m, b.line))
+        return out
+
+    def acquire_summary(self, method: str) -> list[tuple[str, int]]:
+        """Locks (of this class) acquired on any path from ``method``."""
+        out = []
+        for m in sorted(self.reachable(method)):
+            for a in self.scans[m].acquires:
+                out.append((a.lock, a.line))
+        return out
+
+
+def _resolve_attr_class(
+    project: Project, info: _ClassInfo, classes: dict[tuple[str, str], "_ClassInfo"]
+) -> dict[str, "_ClassInfo"]:
+    """attr name -> _ClassInfo for members with a statically known
+    project class: ``self._wal = WalLog(...)`` (plain name, defined
+    locally or from-imported) and ``self._wal = wal.WalLog(...)``
+    (constructor through a module import)."""
+    out: dict[str, _ClassInfo] = {}
+    mod = info.mod
+    for attr, chain in info.cls.attr_ctors.items():
+        target: tuple[str, str] | None = None
+        if "." in chain:
+            head, _, rest = chain.partition(".")
+            imp = mod.imports.get(head)
+            if imp is not None and imp[0] in ("mod", "modroot"):
+                modname = (
+                    imp[1] if imp[0] == "mod"
+                    else chain.rsplit(".", 1)[0]
+                )
+                target = (modname, chain.rsplit(".", 1)[-1])
+        elif chain in mod.classes:
+            target = (mod.name, chain)
+        else:
+            imp = mod.imports.get(chain)
+            if imp and imp[0] == "sym":
+                target = (imp[1], imp[2])
+        if target is not None and target in classes:
+            out[attr] = classes[target]
+    return out
+
+
+def check_lock_order(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    classes: dict[tuple[str, str], _ClassInfo] = {}
+    for name in sorted(project.modules):
+        mod = project.modules[name]
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                classes[(mod.name, node.name)] = _ClassInfo(mod, node)
+
+    # acquisition-order edges: (class, lock) -> (class, lock), each with
+    # one witness site for reporting
+    edges: dict[tuple, dict[tuple, tuple[str, int]]] = {}
+
+    def add_edge(a: tuple, b: tuple, where: tuple[str, int]) -> None:
+        if a != b:  # reentrant same-lock re-acquisition is not ordering
+            edges.setdefault(a, {}).setdefault(b, where)
+
+    seen_block: set[tuple[str, int, str]] = set()
+    for key in sorted(classes):
+        info = classes[key]
+        cname = info.node.name
+        members = _resolve_attr_class(project, info, classes)
+        for unit in sorted(info.scans):
+            scan = info.scans[unit]
+            for entry in info.entry_states[unit]:
+                if INIT in entry:
+                    continue  # pre-publication: single-threaded
+                for acq in scan.acquires:
+                    for h in entry | acq.held_before:
+                        add_edge(
+                            (cname, h), (cname, acq.lock),
+                            (info.mod.rel, acq.line),
+                        )
+                for b in scan.blocking:
+                    held = entry | b.held
+                    if not held:
+                        continue
+                    fp = (info.mod.rel, b.line, b.what)
+                    if fp in seen_block:
+                        continue
+                    seen_block.add(fp)
+                    locks = "/".join(sorted(f"self.{l}" for l in held))
+                    findings.append(Finding(
+                        info.mod.rel, b.line, RULE_BLOCKING,
+                        f"blocking call ({b.what}) in {cname}.{unit} while "
+                        f"holding {locks} — every thread contending on the "
+                        f"lock stalls for its full duration",
+                    ))
+                for call in scan.attr_calls:
+                    target = members.get(call.attr)
+                    if target is None:
+                        continue
+                    held = entry | call.held
+                    tname = target.node.name
+                    for lock2, _line2 in target.acquire_summary(call.callee):
+                        for h in held:
+                            add_edge(
+                                (cname, h), (tname, lock2),
+                                (info.mod.rel, call.line),
+                            )
+                    if not held:
+                        continue
+                    for what, via, _bline in target.blocking_summary(call.callee):
+                        fp = (info.mod.rel, call.line, what)
+                        if fp in seen_block:
+                            continue
+                        seen_block.add(fp)
+                        locks = "/".join(sorted(f"self.{l}" for l in held))
+                        deep = "" if via == call.callee else f" -> {via}"
+                        findings.append(Finding(
+                            info.mod.rel, call.line, RULE_BLOCKING,
+                            f"blocking call ({what}, via {tname}."
+                            f"{call.callee}{deep}) in {cname}.{unit} while "
+                            f"holding {locks} — every thread contending on "
+                            f"the lock stalls for its full duration",
+                        ))
+
+    findings.extend(_report_cycles(edges))
+    return findings
+
+
+def _report_cycles(
+    edges: dict[tuple, dict[tuple, tuple[str, int]]]
+) -> list[Finding]:
+    """Strongly connected components of the order graph with >1 node
+    (or any 2-cycle) are deadlock-capable; report each once, at the
+    lexically first witness site among the component's edges."""
+    index: dict[tuple, int] = {}
+    low: dict[tuple, int] = {}
+    on_stack: set[tuple] = set()
+    stack: list[tuple] = []
+    sccs: list[list[tuple]] = []
+    counter = [0]
+
+    def strongconnect(v: tuple) -> None:
+        # iterative Tarjan (the graph is tiny; recursion would be fine,
+        # but an explicit stack keeps pathological inputs safe)
+        work = [(v, iter(sorted(edges.get(v, {}))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(edges.get(w, {})))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(comp)
+
+    for v in sorted(edges):
+        if v not in index:
+            strongconnect(v)
+
+    findings = []
+    for comp in sccs:
+        comp_set = set(comp)
+        witnesses = [
+            edges[a][b]
+            for a in comp for b in edges.get(a, {})
+            if b in comp_set
+        ]
+        path, line = min(witnesses, key=lambda w: (w[0], w[1]))
+        cycle = " -> ".join(f"{c}.{l}" for c, l in sorted(comp))
+        findings.append(Finding(
+            path, line, RULE_ORDER,
+            f"lock acquisition-order cycle ({cycle}): two threads taking "
+            f"these locks in opposite orders deadlock",
+        ))
+    return findings

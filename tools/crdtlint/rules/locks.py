@@ -61,6 +61,77 @@ class CallEdge:
     held: frozenset
 
 
+@dataclasses.dataclass(frozen=True)
+class AcquireEvent:
+    """One lock-acquisition site (``with self._x:`` or ``.acquire()``)
+    with the lexical lock state just BEFORE it — the raw material of the
+    LOCK002 acquisition-order graph."""
+
+    method: str
+    line: int
+    lock: str
+    held_before: frozenset
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockingEvent:
+    """A call that can block the thread (fsync, socket I/O, sleep,
+    thread join, device sync…) and the lexical lock state at the call —
+    LOCK003 flags those reachable with any lock held."""
+
+    method: str
+    line: int
+    what: str
+    held: frozenset
+
+
+@dataclasses.dataclass(frozen=True)
+class AttrCall:
+    """``self.X.m(...)`` — a method call on a member object. When X's
+    class is statically known (constructed in this class), LOCK002/003
+    follow the edge into that class's methods."""
+
+    method: str
+    line: int
+    attr: str
+    callee: str
+    held: frozenset
+
+
+#: call leaves that block the calling thread regardless of receiver
+BLOCKING_LEAVES = {
+    "fsync": "os.fsync",
+    "sendall": "socket sendall",
+    "recv": "socket recv",
+    "accept": "socket accept",
+    "connect": "socket connect",
+    "create_connection": "socket connect",
+    "getaddrinfo": "DNS resolution",
+    "sleep": "time.sleep",
+    "block_until_ready": "device sync (block_until_ready)",
+    "fsync_dir": "os.fsync (directory)",
+}
+
+#: leaves that block only for specific receiver types — counted when the
+#: receiver is a ``self.`` attribute constructed as one of these
+BLOCKING_RECEIVER_LEAVES = {
+    "join": ("Thread",),
+    "wait": ("Event", "Condition", "Barrier"),
+}
+
+
+def _dotted_chain(node: ast.AST) -> str | None:
+    """``a.b.C`` attribute chain -> "a.b.C" (None when not a plain chain)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
 def _call_receiver_attr(func: ast.AST) -> str | None:
     """Root ``self._x`` of a call-receiver chain: ``self._x.m(...)``,
     ``self._x[k].m(...)``, ``self._x.a.m(...)`` all root at ``_x``."""
@@ -101,6 +172,9 @@ class _MethodScan(ast.NodeVisitor):
         self.held: set[str] = set()
         self.accesses: list[Access] = []
         self.edges: list[CallEdge] = []
+        self.acquires: list[AcquireEvent] = []
+        self.blocking: list[BlockingEvent] = []
+        self.attr_calls: list[AttrCall] = []
 
     # -- lock state ----------------------------------------------------
 
@@ -113,6 +187,10 @@ class _MethodScan(ast.NodeVisitor):
         for item in node.items:
             lock = self._is_lock_attr(item.context_expr)
             if lock is not None:
+                self.acquires.append(AcquireEvent(
+                    self.method, item.context_expr.lineno, lock,
+                    frozenset(self.held),
+                ))
                 # only locks not already held: a nested reentrant
                 # ``with self._lock:`` (RLock) must not release the
                 # outer hold when the inner block exits
@@ -170,12 +248,32 @@ class _MethodScan(ast.NodeVisitor):
     visit_AsyncFor = _visit_loop
     visit_While = _visit_loop
 
+    def _note_blocking(self, func: ast.Attribute | ast.Name, line: int) -> None:
+        leaf = func.attr if isinstance(func, ast.Attribute) else func.id
+        what = BLOCKING_LEAVES.get(leaf)
+        if what is None and isinstance(func, ast.Attribute):
+            # receiver-typed blockers: thread join, event/condition wait
+            ctors = BLOCKING_RECEIVER_LEAVES.get(leaf)
+            if ctors:
+                recv = self_attr(func.value)
+                chain = self.cls.attr_ctors.get(recv) if recv is not None else None
+                ctor = chain.rsplit(".", 1)[-1] if chain else None
+                if ctor in ctors:
+                    what = f"{ctor}.{leaf}"
+        if what is not None:
+            self.blocking.append(
+                BlockingEvent(self.method, line, what, frozenset(self.held))
+            )
+
     def visit_Call(self, node: ast.Call) -> None:
         func = node.func
         if isinstance(func, ast.Attribute):
             lock = self._is_lock_attr(func.value)
             if lock is not None:
                 if func.attr == "acquire":
+                    self.acquires.append(AcquireEvent(
+                        self.method, node.lineno, lock, frozenset(self.held)
+                    ))
                     self.held.add(lock)
                 elif func.attr == "release":
                     self.held.discard(lock)
@@ -192,15 +290,24 @@ class _MethodScan(ast.NodeVisitor):
                     self.visit(arg)
                 self.held.update(self.cls.acquire_wrappers.get(callee, set()))
                 return
+            self._note_blocking(func, node.lineno)
             recv = _call_receiver_attr(func)
             if recv is not None:
                 # method call rooted at a self attribute: potential
                 # in-place mutation of that attribute's object
                 self._record(recv, func.lineno, "call")
+                direct = self_attr(func.value)
+                if direct is not None:
+                    self.attr_calls.append(AttrCall(
+                        self.method, node.lineno, direct, func.attr,
+                        frozenset(self.held),
+                    ))
                 self.visit(func.value)
                 for arg in node.args + [kw.value for kw in node.keywords]:
                     self.visit(arg)
                 return
+        elif isinstance(func, ast.Name):
+            self._note_blocking(func, node.lineno)
         self.generic_visit(node)
 
     # -- accesses ------------------------------------------------------
@@ -267,6 +374,10 @@ class _ClassAnalysis:
         self.lock_attrs = self._find_constructed(("Lock", "RLock"))
         self.exempt_attrs = self._find_constructed(tuple(THREADSAFE_CONSTRUCTORS))
         self.exempt_attrs |= self.lock_attrs
+        #: attr -> constructor leaf name for attrs assigned a direct
+        #: ``self.x = Ctor(...)`` (receiver-typed blocking + the
+        #: cross-class edges of the LOCK002/003 order analysis)
+        self.attr_ctors: dict[str, str] = self._find_attr_ctors()
         # thread-entry units: entry name -> FunctionDef (bound methods
         # and nested defs passed as Thread(target=...))
         self.thread_entries: dict[str, ast.FunctionDef] = {}
@@ -296,6 +407,33 @@ class _ClassAnalysis:
                     attr = self_attr(t)
                     if attr is not None:
                         out.add(attr)
+        return out
+
+    def _find_attr_ctors(self) -> dict[str, str]:
+        """attr -> constructor dotted chain (``WalLog`` / ``wal.WalLog``
+        / ``threading.Thread``) for direct ``self.x = Ctor(...)``
+        assignments. Consumers compare the LEAF for receiver typing and
+        resolve the full chain for cross-class edges."""
+        out: dict[str, str] = {}
+        for body_fn in self.methods.values():
+            for stmt in ast.walk(body_fn):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+                value = stmt.value
+                if not isinstance(value, ast.Call):
+                    continue
+                chain = (
+                    value.func.id
+                    if isinstance(value.func, ast.Name)
+                    else _dotted_chain(value.func)
+                )
+                if chain is None:
+                    continue
+                for t in targets:
+                    attr = self_attr(t)
+                    if attr is not None:
+                        out[attr] = chain
         return out
 
     def _find_thread_entries(self) -> None:
@@ -355,19 +493,19 @@ def _scan_unit(cls: _ClassAnalysis, unit_name: str, fn: ast.FunctionDef) -> _Met
     return scan
 
 
-def _analyse_class(mod: ModuleInfo, node: ast.ClassDef) -> Iterator[Finding]:
-    cls = _ClassAnalysis(mod, node)
-    if not cls.lock_attrs:
-        return
-
+def analyse_units(
+    cls: _ClassAnalysis,
+) -> tuple[dict[str, "_MethodScan"], dict[str, set[frozenset]]]:
+    """Scan every unit (method or thread entry) of one class and
+    propagate entry lock states interprocedurally: public methods and
+    thread entries start lock-free, ``__init__`` gets the INIT
+    pseudo-state (pre-publication), and each call edge forwards
+    caller-entry ∪ call-site lexical locks to the callee. Shared by
+    LOCK001 (guard inference) and LOCK002/003 (order/blocking)."""
     units: dict[str, ast.FunctionDef] = dict(cls.methods)
     units.update(cls.thread_entries)
     scans = {name: _scan_unit(cls, name, fn) for name, fn in units.items()}
 
-    # entry states: public methods + thread entries start lock-free;
-    # __init__ gets the INIT pseudo-state (pre-publication, single-
-    # threaded — it neither mints guards nor produces findings, but its
-    # exclusive callees inherit the exemption through propagation)
     entry_states: dict[str, set[frozenset]] = {name: set() for name in units}
     for name in units:
         if name in cls.thread_entries or not name.startswith("_"):
@@ -390,6 +528,15 @@ def _analyse_class(mod: ModuleInfo, node: ast.ClassDef) -> Iterator[Finding]:
                     if state not in entry_states[edge.callee]:
                         entry_states[edge.callee].add(state)
                         changed = True
+    return scans, entry_states
+
+
+def _analyse_class(mod: ModuleInfo, node: ast.ClassDef) -> Iterator[Finding]:
+    cls = _ClassAnalysis(mod, node)
+    if not cls.lock_attrs:
+        return
+
+    scans, entry_states = analyse_units(cls)
 
     # guarded attributes: attr -> set of locks it was written under
     guards: dict[str, set[str]] = {}

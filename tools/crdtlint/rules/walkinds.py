@@ -1,0 +1,168 @@
+"""WAL001/WAL002 — WAL record-kind exhaustiveness.
+
+Every record kind the runtime *writes* must be handled by every
+dispatcher that *reads* the log, or durability degrades silently:
+
+- **WAL001** — a produced kind with no arm in the recovery replay
+  dispatcher: records of that kind are skipped on restart (the
+  "unknown kind" warning path), i.e. acknowledged-durable data does not
+  come back. Also fires when kinds are produced but NO replay
+  dispatcher exists at all — a rename must not disarm the rule.
+- **WAL002** — a produced kind with no explicit classification in the
+  log-shipping serving scan: unknown kinds there become serving
+  BARRIERS (safe but degraded — every catch-up past one falls back to
+  the digest walk). A new kind must be classified on purpose: servable
+  (its touched rows computed) or an explicit barrier, never by default.
+
+Discovery is structural, not name-listed: a *producer* is any dict
+literal with both a ``"kind": <str>`` and a ``"seq"`` key (the WAL
+record schema); a *dispatcher* is any function comparing an expression
+rooted in ``[...]["kind"]`` / ``.get("kind")`` against string literals.
+Dispatcher role comes from the function name: ``replay``/``recover``
+functions are recovery, ``scan_log``/``serve``/``catchup`` functions
+are the serving classifier.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.crdtlint.engine import Finding, ModuleInfo, Project
+from tools.crdtlint.rules import iter_function_defs
+
+RULE_REPLAY = "WAL001"
+RULE_SERVING = "WAL002"
+
+_REPLAY_NAME = re.compile(r"replay|recover")
+_SERVING_NAME = re.compile(r"scan_log|serve_log|catchup|log_rows")
+
+
+def _producers(mod: ModuleInfo) -> list[tuple[str, int]]:
+    """``(kind, line)`` for every WAL record literal in this module."""
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        keys = {
+            k.value: v
+            for k, v in zip(node.keys, node.values)
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)
+        }
+        if "kind" not in keys or "seq" not in keys:
+            continue
+        kind = keys["kind"]
+        if isinstance(kind, ast.Constant) and isinstance(kind.value, str):
+            out.append((kind.value, node.lineno))
+    return out
+
+
+def _is_kind_expr(node: ast.AST, kind_names: set[str]) -> bool:
+    """``rec["kind"]`` / ``rec.get("kind")`` / a name bound from one."""
+    if isinstance(node, ast.Name):
+        return node.id in kind_names
+    if isinstance(node, ast.Subscript):
+        sl = node.slice
+        return isinstance(sl, ast.Constant) and sl.value == "kind"
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        return (
+            node.func.attr == "get"
+            and len(node.args) >= 1
+            and isinstance(node.args[0], ast.Constant)
+            and node.args[0].value == "kind"
+        )
+    return False
+
+
+def _dispatcher_kinds(fn: ast.FunctionDef) -> set[str] | None:
+    """String literals this function compares a kind expression against
+    (``==``, ``!=``, ``in``/``not in`` over literal containers); None
+    when the function never inspects a kind."""
+    kind_names: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and _is_kind_expr(node.value, set()):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    kind_names.add(t.id)
+    compared: set[str] = set()
+    saw_kind_compare = False
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left, *node.comparators]
+        if not any(_is_kind_expr(s, kind_names) for s in sides):
+            continue
+        saw_kind_compare = True
+        for s in sides:
+            if isinstance(s, ast.Constant) and isinstance(s.value, str):
+                compared.add(s.value)
+            elif isinstance(s, (ast.Tuple, ast.List, ast.Set)):
+                compared.update(
+                    e.value for e in s.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                )
+    return compared if saw_kind_compare else None
+
+
+def check_wal_kinds(project: Project) -> list[Finding]:
+    produced: dict[str, tuple[str, int]] = {}  # kind -> first (path, line)
+    for name in sorted(project.modules):
+        mod = project.modules[name]
+        for kind, line in _producers(mod):
+            produced.setdefault(kind, (mod.rel, line))
+    if not produced:
+        return []
+
+    replay: list[tuple[ModuleInfo, str, set[str]]] = []
+    serving: list[tuple[ModuleInfo, str, set[str]]] = []
+    for name in sorted(project.modules):
+        mod = project.modules[name]
+        for qual, fn in iter_function_defs(mod.tree):
+            kinds = _dispatcher_kinds(fn)
+            if kinds is None:
+                continue
+            fname = qual[-1]
+            if _REPLAY_NAME.search(fname):
+                replay.append((mod, ".".join(qual), kinds))
+            elif _SERVING_NAME.search(fname):
+                serving.append((mod, ".".join(qual), kinds))
+
+    findings: list[Finding] = []
+    first_path, first_line = min(produced.values())
+    if not replay:
+        findings.append(Finding(
+            first_path, first_line, RULE_REPLAY,
+            "WAL record kinds are produced but no recovery replay "
+            "dispatcher was found (a function matching 'replay|recover' "
+            "comparing record kinds) — durable records would never be "
+            "replayed",
+        ))
+    if not serving:
+        findings.append(Finding(
+            first_path, first_line, RULE_SERVING,
+            "WAL record kinds are produced but no log-shipping serving "
+            "classifier was found (a function matching "
+            "'scan_log|serve_log|catchup|log_rows' comparing record "
+            "kinds) — catch-up cannot classify records",
+        ))
+    for kind in sorted(produced):
+        path, line = produced[kind]
+        for mod, qual, kinds in replay:
+            if kind not in kinds:
+                findings.append(Finding(
+                    path, line, RULE_REPLAY,
+                    f"WAL record kind {kind!r} has no replay arm in "
+                    f"{qual} — records of this kind are silently skipped "
+                    f"on crash recovery (durability hole)",
+                ))
+        for mod, qual, kinds in serving:
+            if kind not in kinds:
+                findings.append(Finding(
+                    path, line, RULE_SERVING,
+                    f"WAL record kind {kind!r} has no explicit serving "
+                    f"classification in {qual} — it degrades every "
+                    f"catch-up stream to a barrier + digest-walk "
+                    f"fallback; classify it as servable or as an "
+                    f"intentional barrier",
+                ))
+    return findings

@@ -1,0 +1,445 @@
+"""WIRE001–WIRE005 — wire-protocol drift.
+
+The anti-entropy protocol is only correct when three surfaces stay in
+lockstep: the ``*Msg`` dataclasses in ``runtime/sync.py`` (the wire
+vocabulary), the replica's isinstance dispatch ladder (every message
+kind must have a handler arm), and the transport codecs (every field
+must serialise; every frame kind must decode). Nothing but convention
+kept them aligned — these rules make the convention checkable:
+
+- **WIRE001** — a protocol-module ``*Msg`` dataclass with no arm in any
+  dispatch ladder: the message is sent (or will be) and silently
+  unhandled, which on the current ladder means ``TypeError: unknown
+  message`` at the receiver, mid-sync-round.
+- **WIRE002** — a ladder arm that can never fire: its class does not
+  exist in the module the arm names (renamed/removed message), or a
+  duplicate arm for a class already handled earlier in the same ladder.
+- **WIRE003** — a message field whose annotated type is not
+  wire-serializable (sockets, locks, callables, device arrays…):
+  pickling fails — or worse, "works" in-process and fails only on the
+  TCP transport, i.e. only in production topologies.
+- **WIRE004** — a frame-kind constant in a codec module that is sent
+  but never compared on the receive path: the peer drops the frame as
+  unknown, so the feature silently degrades (the codec's documented
+  forward-compat behaviour — fine for *newer peers*, a bug in the
+  *same build*).
+- **WIRE005** — the wire-compat lock: the protocol module's per-message
+  ordered ``(field, type)`` lists are hashed against the checked-in
+  ``protocol_manifest.json``. Adding/removing/reordering/retyping a
+  wire field without regenerating the manifest
+  (``--write-protocol-manifest``) turns the gate red, forcing the
+  mixed-version-cluster conversation (MIGRATING.md) at review time.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+from pathlib import Path
+
+from tools.crdtlint.engine import Finding, ModuleInfo, Project
+
+#: the checked-in manifest, anchored beside the linter like the baseline
+DEFAULT_MANIFEST = Path(__file__).resolve().parent.parent / "protocol_manifest.json"
+
+#: leaf type names a wire message field may be built from. The codecs
+#: pickle whole messages, so this is the *contract* subset: plain data
+#: + numpy containers. Anything else (Callable, Lock, socket, a project
+#: class, a bare jax Array) either fails to pickle or smuggles
+#: process-local state onto the wire.
+_WIRE_SAFE_LEAVES = {
+    "int", "float", "bool", "str", "bytes", "bytearray", "complex",
+    "None", "NoneType", "Any", "Hashable", "Optional", "Union",
+    "list", "dict", "tuple", "set", "frozenset",
+    "List", "Dict", "Tuple", "Set", "FrozenSet", "Sequence", "Mapping",
+    "Iterable", "ndarray", "generic", "int64", "int32", "float32",
+    "float64", "uint64", "bool_",
+}
+
+
+def _dataclass_messages(mod: ModuleInfo) -> dict[str, ast.ClassDef]:
+    """``*Msg``-named dataclasses defined at this module's top level."""
+    out: dict[str, ast.ClassDef] = {}
+    for node in mod.tree.body:
+        if not isinstance(node, ast.ClassDef) or not node.name.endswith("Msg"):
+            continue
+        for dec in node.decorator_list:
+            leaf = dec
+            if isinstance(leaf, ast.Call):
+                leaf = leaf.func
+            name = leaf.attr if isinstance(leaf, ast.Attribute) else (
+                leaf.id if isinstance(leaf, ast.Name) else None
+            )
+            if name == "dataclass":
+                out[node.name] = node
+                break
+    return out
+
+
+def protocol_module(project: Project) -> tuple[ModuleInfo, dict[str, ast.ClassDef]] | None:
+    """The module carrying the wire vocabulary: the one defining the
+    most ``*Msg`` dataclasses (at least two — a single incidental Msg
+    class does not make a protocol)."""
+    best: tuple[ModuleInfo, dict[str, ast.ClassDef]] | None = None
+    for name in sorted(project.modules):
+        mod = project.modules[name]
+        msgs = _dataclass_messages(mod)
+        if len(msgs) >= 2 and (best is None or len(msgs) > len(best[1])):
+            best = (mod, msgs)
+    return best
+
+
+def message_fields(cls: ast.ClassDef) -> list[tuple[str, str]]:
+    """Ordered ``(name, type_source)`` wire fields of one dataclass."""
+    fields: list[tuple[str, str]] = []
+    for stmt in cls.body:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            fields.append((stmt.target.id, ast.unparse(stmt.annotation)))
+    return fields
+
+
+def _annotation_leaves(node: ast.AST):
+    """Every leaf type name referenced by an annotation expression:
+    ``dict[tuple[int, int], np.ndarray | None]`` -> dict, tuple, int,
+    ndarray, None. Dotted names contribute their final attribute (the
+    ``np.``/``typing.`` prefix is namespacing, not the type)."""
+    if isinstance(node, ast.Name):
+        yield node.id
+    elif isinstance(node, ast.Attribute):
+        yield node.attr
+    elif isinstance(node, ast.Constant):
+        if node.value is None:
+            yield "None"
+        elif isinstance(node.value, str):  # string annotation: reparse
+            try:
+                yield from _annotation_leaves(ast.parse(node.value, mode="eval").body)
+            except SyntaxError:
+                yield node.value
+    elif isinstance(node, ast.Subscript):
+        yield from _annotation_leaves(node.value)
+        yield from _annotation_leaves(node.slice)
+    elif isinstance(node, ast.Tuple):
+        for elt in node.elts:
+            yield from _annotation_leaves(elt)
+    elif isinstance(node, ast.BinOp):  # X | Y unions
+        yield from _annotation_leaves(node.left)
+        yield from _annotation_leaves(node.right)
+    elif isinstance(node, ast.Index):  # pragma: no cover - py<3.9 AST
+        yield from _annotation_leaves(node.value)
+
+
+# ----------------------------------------------------------------------
+# dispatch ladders
+
+
+def _isinstance_classes(test: ast.AST) -> list[tuple[str, ast.AST]] | None:
+    """``isinstance(x, C)`` / ``isinstance(x, (C, D))`` ->
+    [(subject_name, class_expr), ...]; None for any other test."""
+    if not (
+        isinstance(test, ast.Call)
+        and isinstance(test.func, ast.Name)
+        and test.func.id == "isinstance"
+        and len(test.args) == 2
+        and isinstance(test.args[0], ast.Name)
+    ):
+        return None
+    subject = test.args[0].id
+    cls_expr = test.args[1]
+    elts = cls_expr.elts if isinstance(cls_expr, ast.Tuple) else [cls_expr]
+    return [(subject, e) for e in elts]
+
+
+def _resolve_class(
+    project: Project, mod: ModuleInfo, expr: ast.AST
+) -> tuple[str | None, str | None]:
+    """Resolve a ladder arm's class expression to ``(module_name,
+    class_name)``. ``(None, None)`` = not project-related (builtin or
+    third-party — ignored); ``(modname, None)`` = names a project
+    module but the class is missing there (a WIRE002 broken arm)."""
+    if isinstance(expr, ast.Name):
+        if expr.id in mod.classes:
+            return mod.name, expr.id
+        imp = mod.imports.get(expr.id)
+        if imp and imp[0] == "sym":
+            target = project.modules.get(imp[1])
+            if target is not None:
+                return (imp[1], imp[2]) if imp[2] in target.classes else (imp[1], None)
+        return None, None
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        imp = mod.imports.get(expr.value.id)
+        if imp and imp[0] == "mod":
+            target = project.modules.get(imp[1])
+            if target is not None:
+                return (imp[1], expr.attr) if expr.attr in target.classes else (imp[1], None)
+    return None, None
+
+
+def _ladders(project: Project, mod: ModuleInfo):
+    """Yield dispatch ladders: if/elif chains with >= 2 isinstance arms
+    over one subject, as ``[(line, modname, clsname|None), ...]``."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.If):
+            continue
+        # only the HEAD of a chain (an elif is the sole If in its
+        # parent's orelse — we detect heads by walking every If and
+        # skipping those that appear as a single-orelse child)
+        arms: list[tuple[int, str, str | None]] = []
+        subjects: set[str] = set()
+        cur: ast.stmt | None = node
+        while isinstance(cur, ast.If):
+            tests = _isinstance_classes(cur.test)
+            if tests is not None:
+                for subject, cls_expr in tests:
+                    modname, clsname = _resolve_class(project, mod, cls_expr)
+                    if modname is not None:
+                        subjects.add(subject)
+                        arms.append((cur.test.lineno, modname, clsname))
+            cur = cur.orelse[0] if len(cur.orelse) == 1 else None
+        if len(arms) >= 2 and len(subjects) == 1:
+            yield arms
+
+
+def _all_ladders(project: Project) -> list[tuple[ModuleInfo, list]]:
+    out = []
+    seen_heads: set[int] = set()
+    for name in sorted(project.modules):
+        mod = project.modules[name]
+        for arms in _ladders(project, mod):
+            # an elif chain re-yields its suffixes (ast.walk visits the
+            # nested Ifs too); keep only maximal ladders by dropping any
+            # whose first arm line we already covered
+            if arms[0][0] in seen_heads:
+                continue
+            seen_heads.update(a[0] for a in arms)
+            out.append((mod, arms))
+    return out
+
+
+# ----------------------------------------------------------------------
+# manifest
+
+
+def compute_manifest(project: Project) -> dict | None:
+    """The manifest stanza for this project's protocol module (None
+    when the project has no protocol module)."""
+    proto = protocol_module(project)
+    if proto is None:
+        return None
+    mod, msgs = proto
+    messages = {}
+    for name in sorted(msgs):
+        fields = message_fields(msgs[name])
+        blob = json.dumps(fields, separators=(",", ":")).encode()
+        messages[name] = {
+            "fields": fields,
+            "sha256": hashlib.sha256(blob).hexdigest(),
+        }
+    return {"module": mod.rel, "messages": messages}
+
+
+def load_manifest(path: Path) -> dict:
+    return json.loads(path.read_text(encoding="utf-8"))
+
+
+def write_manifest(path: Path, packages: dict[str, dict]) -> None:
+    path.write_text(
+        json.dumps({"version": 1, "packages": packages}, indent=2,
+                   sort_keys=True) + "\n",
+        encoding="utf-8",
+    )
+
+
+# ----------------------------------------------------------------------
+# the rules
+
+
+def check_wire(project: Project) -> list[Finding]:
+    # the codec check stands alone: a package can have a frame codec
+    # without (or before) a message protocol module
+    findings: list[Finding] = list(_check_frame_kinds(project))
+    proto = protocol_module(project)
+    if proto is None:
+        return findings
+    mod, msgs = proto
+    ladders = _all_ladders(project)
+
+    # WIRE001: every message class needs a dispatch arm somewhere
+    handled: set[str] = set()
+    for lmod, arms in ladders:
+        for _line, modname, clsname in arms:
+            if modname == mod.name and clsname is not None:
+                handled.add(clsname)
+    for name in sorted(msgs):
+        if name not in handled:
+            findings.append(Finding(
+                mod.rel, msgs[name].lineno, "WIRE001",
+                f"wire message {name} has no isinstance arm in any "
+                f"dispatch ladder — receivers will raise on it",
+            ))
+
+    # WIRE002: broken or duplicate arms within one ladder
+    for lmod, arms in ladders:
+        seen: set[tuple[str, str]] = set()
+        for line, modname, clsname in arms:
+            if clsname is None:
+                findings.append(Finding(
+                    lmod.rel, line, "WIRE002",
+                    f"dispatch arm names a class missing from {modname} "
+                    f"— renamed or removed wire message?",
+                ))
+            elif (modname, clsname) in seen:
+                findings.append(Finding(
+                    lmod.rel, line, "WIRE002",
+                    f"unreachable dispatch arm: {clsname} already "
+                    f"handled earlier in this ladder",
+                ))
+            else:
+                seen.add((modname, clsname))
+
+    # WIRE003: wire-serializable field types only
+    for name in sorted(msgs):
+        for stmt in msgs[name].body:
+            if not (isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name)):
+                continue
+            bad = sorted(
+                leaf for leaf in set(_annotation_leaves(stmt.annotation))
+                if leaf not in _WIRE_SAFE_LEAVES
+            )
+            if bad:
+                findings.append(Finding(
+                    mod.rel, stmt.lineno, "WIRE003",
+                    f"{name}.{stmt.target.id}: type {ast.unparse(stmt.annotation)!r} "
+                    f"is not wire-serializable ({', '.join(bad)}) — wire "
+                    f"messages carry plain data + numpy arrays only",
+                ))
+
+    findings.extend(_check_manifest(project, mod, msgs))
+    return findings
+
+
+def _check_frame_kinds(project: Project) -> list[Finding]:
+    """WIRE004 over every codec module: a module-level ``_UPPER = <int>``
+    constant used as a frame kind on the send side (arg of a
+    ``*send_frame`` call, first arg of ``.enqueue``, or head of a frame
+    tuple) must appear in at least one equality comparison — the
+    receive-path decode. Sent-but-undecodable = every such frame is
+    dropped as unknown by the peer."""
+    findings: list[Finding] = []
+    for name in sorted(project.modules):
+        mod = project.modules[name]
+        # codec modules only: anything defining a frame writer. Plain
+        # modules may use _UPPER int constants in tuples for their own
+        # reasons — that is not wire traffic.
+        if not any(
+            isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n.name.endswith("send_frame")
+            for n in mod.tree.body
+        ):
+            continue
+        consts: dict[str, int] = {}
+        for node in mod.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)
+                and not isinstance(node.value.value, bool)
+            ):
+                cname = node.targets[0].id
+                if cname.startswith("_") and cname[1:].replace("_", "").isupper():
+                    consts[cname] = node.lineno
+        if not consts:
+            continue
+        sent: set[str] = set()
+        compared: set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                leaf = (
+                    node.func.attr if isinstance(node.func, ast.Attribute)
+                    else node.func.id if isinstance(node.func, ast.Name) else ""
+                )
+                if leaf.endswith("send_frame"):
+                    for arg in node.args:
+                        if isinstance(arg, ast.Name) and arg.id in consts:
+                            sent.add(arg.id)
+                elif leaf == "enqueue" and node.args:
+                    a0 = node.args[0]
+                    if isinstance(a0, ast.Name) and a0.id in consts:
+                        sent.add(a0.id)
+            elif isinstance(node, ast.Tuple) and node.elts:
+                head = node.elts[0]
+                if isinstance(head, ast.Name) and head.id in consts:
+                    sent.add(head.id)
+            elif isinstance(node, ast.Compare):
+                for side in [node.left, *node.comparators]:
+                    if isinstance(side, ast.Name) and side.id in consts:
+                        compared.add(side.id)
+        for cname in sorted(sent - compared):
+            findings.append(Finding(
+                mod.rel, consts[cname], "WIRE004",
+                f"frame kind {cname} is sent but never compared on a "
+                f"receive path — peers drop it as an unknown frame",
+            ))
+    return findings
+
+
+def _check_manifest(
+    project: Project, mod: ModuleInfo, msgs: dict[str, ast.ClassDef]
+) -> list[Finding]:
+    findings: list[Finding] = []
+    path = project.manifest_path or DEFAULT_MANIFEST
+    try:
+        manifest = load_manifest(path)
+    except FileNotFoundError:
+        manifest = None
+    except (ValueError, KeyError) as e:
+        return [Finding(mod.rel, 1, "WIRE005", f"unreadable protocol manifest {path}: {e}")]
+    packages = manifest.get("packages") if isinstance(manifest, dict) else None
+    if manifest is not None and not isinstance(packages, dict):
+        # JSON-valid but structurally mangled (e.g. a bad merge-conflict
+        # resolution): a finding, never a lint crash
+        return [Finding(
+            mod.rel, 1, "WIRE005",
+            f"malformed protocol manifest {path}: 'packages' must be an "
+            f"object — regenerate with --write-protocol-manifest",
+        )]
+    if manifest is None or project.package_name not in packages:
+        # no wire-compat lock recorded for this package (fixture
+        # packages, fresh adoptions): nothing to drift from. The gate
+        # test pins the REAL package's presence in the manifest.
+        return findings
+    entry = packages[project.package_name]
+    recorded = entry.get("messages", {}) if isinstance(entry, dict) else {}
+    if not isinstance(recorded, dict):
+        recorded = {}
+    recorded = {k: v for k, v in recorded.items() if isinstance(v, dict)}
+    current = compute_manifest(project)["messages"]
+    for name in sorted(set(recorded) | set(current)):
+        if name not in current:
+            findings.append(Finding(
+                mod.rel, 1, "WIRE005",
+                f"wire message {name} is in the protocol manifest but no "
+                f"longer defined — removing a message is a wire-compat "
+                f"break; regenerate with --write-protocol-manifest",
+            ))
+        elif name not in recorded:
+            findings.append(Finding(
+                mod.rel, msgs[name].lineno, "WIRE005",
+                f"wire message {name} is not in the protocol manifest — "
+                f"new messages must be recorded (--write-protocol-manifest) "
+                f"so field drift is locked from day one",
+            ))
+        elif recorded[name].get("sha256") != current[name]["sha256"]:
+            old = [f for f, _t in recorded[name].get("fields", [])]
+            new = [f for f, _t in current[name]["fields"]]
+            findings.append(Finding(
+                mod.rel, msgs[name].lineno, "WIRE005",
+                f"wire fields of {name} drifted from the protocol manifest "
+                f"(recorded {old}, current {new} — order and types count): "
+                f"adding/removing/reordering wire fields changes what "
+                f"peers deserialize; review mixed-version compat "
+                f"(MIGRATING.md) then --write-protocol-manifest",
+            ))
+    return findings
