@@ -1002,6 +1002,148 @@ def bench_catchup():
 
 
 # ---------------------------------------------------------------------------
+# batched replica fleets (ISSUE 6: one vmapped dispatch serves N replicas)
+
+def bench_fleet():
+    """``--fleet``: aggregate ingress throughput, batched fleet vs N
+    per-replica event loops, at 64/256/1024 simulated replicas on CPU.
+
+    Topology per size N: N sender replicas, each pushing delta-interval
+    ``EntriesMsg`` slices to one fleet member and one solo receiver
+    (pairwise-equal node ids, identical streams). The measured quantity
+    per round is draining all N receiver mailboxes: the solo universe
+    runs N ``process_pending`` loops (one ``merge_rows`` dispatch per
+    replica — today's one-loop-per-replica shape), the fleet drains all
+    N into ONE vmapped kernel launch over a leading replica axis
+    (``runtime/transition.fleet_merge_rows``). Walk back-traffic is
+    filtered to entries (the ingest-bench methodology): merge
+    throughput is the quantity, not digest-walk cost, which is
+    identical per replica on both sides. Parity is asserted IN-RUN
+    after the timed rounds: every fleet member's state arrays must be
+    bit-identical to its solo twin's, and sequence numbers equal — the
+    speedup is disqualified if it changes observable state. Host-bound
+    dispatch amortisation is the measured effect, so this runs wherever
+    invoked (no device claim dance)."""
+    import dataclasses as _dc
+    import statistics
+
+    from delta_crdt_ex_tpu import AWLWWMap
+    from delta_crdt_ex_tpu.api import start_link
+    from delta_crdt_ex_tpu.models.binned import BinnedStore
+    from delta_crdt_ex_tpu.runtime import sync as sync_proto
+    from delta_crdt_ex_tpu.runtime.clock import LogicalClock
+    from delta_crdt_ex_tpu.runtime.fleet import Fleet
+    from delta_crdt_ex_tpu.runtime.transport import LocalTransport
+
+    sizes = [8, 16] if SMOKE else [64, 256, 1024]
+    rounds = 2 if SMOKE else 5
+    keys_per_round = 2 if SMOKE else 4
+    depth = 6  # 64 buckets per replica: the many-small-replicas shape
+    cols = tuple(f.name for f in _dc.fields(BinnedStore))
+
+    def entries_to(transport, addr):
+        msgs = [
+            m
+            for m in transport.drain(addr)
+            if isinstance(m, sync_proto.EntriesMsg)
+        ]
+        for m in msgs:
+            transport.send(addr, m)
+        return len(msgs)
+
+    def run_size(n: int) -> dict:
+        _stage(f"fleet size {n}: building {3 * n} replicas")
+        transport = LocalTransport()
+        clock = LogicalClock()
+        mk = lambda **kw: start_link(
+            AWLWWMap, threaded=False, transport=transport, clock=clock,
+            capacity=(1 << depth) * 16, tree_depth=depth, **kw,
+        )
+        senders = [mk(name=f"flt_s{n}_{i}") for i in range(n)]
+        fleet = Fleet(
+            [mk(name=f"flt_f{n}_{i}", node_id=10_000 + i) for i in range(n)]
+        )
+        solos = [mk(name=f"flt_o{n}_{i}", node_id=10_000 + i) for i in range(n)]
+        for i, s in enumerate(senders):
+            s.set_neighbours([fleet.replicas[i], solos[i]])
+
+        dts: dict[str, list[float]] = {"fleet": [], "solo": []}
+        for rnd in range(rounds + 1):  # round 0 is jit/compile warmup
+            base = 1_000_003 * rnd
+            for i, s in enumerate(senders):
+                for j in range(keys_per_round):
+                    k = base + i * 1000 + j
+                    s.mutate("add", [k, k])
+            for s in senders:
+                s.sync_to_all()
+            for r in fleet.replicas:
+                assert entries_to(transport, r.addr) >= 1
+            t0 = time.perf_counter()
+            fleet.drain()
+            if rnd > 0:
+                dts["fleet"].append(time.perf_counter() - t0)
+            for r in solos:
+                assert entries_to(transport, r.addr) >= 1
+            t0 = time.perf_counter()
+            for r in solos:
+                r.process_pending()
+            if rnd > 0:
+                dts["solo"].append(time.perf_counter() - t0)
+            for s in senders:
+                transport.drain(s.addr)  # walk back-traffic: not measured
+
+        # in-run parity gate: the speedup must not change observable state
+        for i in range(n):
+            rf, rs = fleet.replicas[i], solos[i]
+            assert rf._seq == rs._seq > 0, (n, i)
+            for c in cols:
+                assert np.array_equal(
+                    np.asarray(getattr(rf.state, c)),
+                    np.asarray(getattr(rs.state, c)),
+                ), f"fleet/solo state diverged at size {n}, member {i}: {c}"
+
+        rate = lambda ds: n / statistics.median(ds)
+        f_rate, s_rate = rate(dts["fleet"]), rate(dts["solo"])
+        st = fleet.stats()
+        out = {
+            "replicas": n,
+            "fleet_merges_per_sec": round(f_rate, 2),
+            "solo_merges_per_sec": round(s_rate, 2),
+            "speedup": round(f_rate / s_rate, 3),
+            "aggregate_merges_per_sec": {
+                "fleet": round(rounds * n / sum(dts["fleet"]), 2),
+                "solo": round(rounds * n / sum(dts["solo"]), 2),
+            },
+            "avg_occupancy": st["avg_occupancy"],
+            "occupancy_hist": {str(k): v for k, v in st["occupancy_hist"].items()},
+            "ragged_fill_ratio": st["ragged_fill_ratio"],
+            "fallbacks": st["fallbacks"],
+            "parity": "bit_for_bit_state_checked",
+        }
+        log(
+            f"fleet {n}: {f_rate:.1f} vs solo {s_rate:.1f} merges/sec "
+            f"({out['speedup']}x; occupancy {st['avg_occupancy']}, "
+            f"fill {st['ragged_fill_ratio']})"
+        )
+        return out
+
+    results = {str(n): run_size(n) for n in sizes}
+    gate = str(16 if SMOKE else 256)
+    _emit({
+        "metric": "fleet_batched_merges_per_sec" + ("_smoke" if SMOKE else ""),
+        "unit": "merges/sec",
+        "stat": f"median_of_{rounds}_rounds",
+        "value": results[gate]["fleet_merges_per_sec"],
+        "speedup_at_gate": results[gate]["speedup"],
+        "sizes": results,
+        "rounds": rounds,
+        "keys_per_round": keys_per_round,
+        "tree_depth": depth,
+        "backend": "cpu",
+    })
+
+
+# ---------------------------------------------------------------------------
 # Python baseline (BEAM stand-in; see module docstring)
 
 def bench_python(seed=0):
@@ -1247,6 +1389,9 @@ def main():
         return
     if "--catchup" in sys.argv:
         bench_catchup()
+        return
+    if "--fleet" in sys.argv:
+        bench_fleet()
         return
     if "--tpu-child" in sys.argv:
         # SIGTERM → clean Python unwind (finalizers run, the device

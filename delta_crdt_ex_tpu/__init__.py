@@ -41,6 +41,7 @@ __all__ = [
     "AWSet",
     "DeltaCrdt",
     "FileStorage",
+    "Fleet",
     "MemoryStorage",
     "Replica",
     "Storage",
@@ -51,6 +52,7 @@ __all__ = [
     "mutate_batch",
     "read",
     "set_neighbours",
+    "start_fleet",
     "start_link",
 ]
 
@@ -63,6 +65,7 @@ _EXPORTS = {
     "AWLWWMap": ("delta_crdt_ex_tpu.models.binned_map", "BinnedAWLWWMap"),
     "AWSet": ("delta_crdt_ex_tpu.models.binned_map", "AWSet"),
     "DeltaCrdt": ("delta_crdt_ex_tpu.api", "DeltaCrdt"),
+    "Fleet": ("delta_crdt_ex_tpu.runtime.fleet", "Fleet"),
     "MemoryStorage": ("delta_crdt_ex_tpu.runtime.storage", "MemoryStorage"),
     "FileStorage": ("delta_crdt_ex_tpu.runtime.storage", "FileStorage"),
     "Replica": ("delta_crdt_ex_tpu.runtime.replica", "Replica"),
@@ -74,6 +77,7 @@ _EXPORTS = {
     "mutate_batch": ("delta_crdt_ex_tpu.api", "mutate_batch"),
     "read": ("delta_crdt_ex_tpu.api", "read"),
     "set_neighbours": ("delta_crdt_ex_tpu.api", "set_neighbours"),
+    "start_fleet": ("delta_crdt_ex_tpu.api", "start_fleet"),
     "start_link": ("delta_crdt_ex_tpu.api", "start_link"),
 }
 

@@ -37,6 +37,7 @@ from __future__ import annotations
 from typing import Any
 
 from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap as AWLWWMap
+from delta_crdt_ex_tpu.runtime.fleet import Fleet
 from delta_crdt_ex_tpu.runtime.replica import Replica
 
 DEFAULT_SYNC_INTERVAL = 0.2  # seconds (reference: 200 ms, delta_crdt.ex:31)
@@ -103,6 +104,55 @@ def start_link(crdt_module=AWLWWMap, *, threaded: bool = True, **opts) -> Replic
     if threaded:
         replica.start()
     return replica
+
+
+def start_fleet(
+    n: int,
+    crdt_module=AWLWWMap,
+    *,
+    threaded: bool = True,
+    names: "list | None" = None,
+    min_batch: int = 2,
+    **opts,
+) -> Fleet:
+    """Start ``n`` replicas served by ONE batched event loop (ISSUE 6:
+    batched replica fleets — no reference analog; the BEAM gives every
+    CRDT its own process, which is exactly the per-user-process cost
+    this replaces).
+
+    The fleet drains all ``n`` mailboxes per tick and joins compatible
+    sync slices across replicas with one ``vmap``-batched kernel
+    dispatch over a leading replica axis, instead of one dispatch (and
+    one thread) per replica — the served-users-per-host lever
+    (``bench.py --fleet``: ≥3× aggregate merges/sec vs per-replica
+    loops at 256 replicas, bit-for-bit parity asserted in-run).
+    Observable semantics per member are identical to solo replicas:
+    WAL records, acks, diffs, and telemetry fan back out per replica
+    (``tests/test_fleet.py`` pins state bits, WAL bytes, and ack
+    streams against solo runs).
+
+    ``opts`` are per-replica ``start_link`` options (shared by all
+    members; pass ``names`` for explicit member names — a shared
+    ``wal_dir`` is safe, segments are per-name). Returns the
+    :class:`~delta_crdt_ex_tpu.runtime.fleet.Fleet`; its ``.replicas``
+    are ordinary :class:`Replica` handles for ``mutate``/``read``/
+    ``set_neighbours``. ``threaded=False`` leaves driving to the
+    caller (``fleet.tick()`` / ``fleet.drain()`` +
+    ``fleet.run_duties()``)."""
+    if names is not None and len(names) != n:
+        raise ValueError(f"{len(names)} names for {n} replicas")
+    opts.setdefault("sync_interval", DEFAULT_SYNC_INTERVAL)
+    opts.setdefault("max_sync_size", DEFAULT_MAX_SYNC_SIZE)
+    replicas = []
+    for i in range(n):
+        member = dict(opts)
+        if names is not None:
+            member["name"] = names[i]
+        replicas.append(Replica(crdt_module, **member))
+    fleet = Fleet(replicas, min_batch=min_batch)
+    if threaded:
+        fleet.start()
+    return fleet
 
 
 def child_spec(opts: dict | None = None) -> dict:

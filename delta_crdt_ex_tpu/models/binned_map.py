@@ -210,7 +210,9 @@ def merge_rows_into(state: BinnedStore, sl, on_grow=None):
 _WIRE_ENTRY_COLS = ("key", "valh", "ts", "ctr", "alive")
 
 
-def combine_entry_arrays(arrays_list: list) -> "tuple[binned_ops.RowSlice, list]":
+def combine_entry_arrays(
+    arrays_list: list, to_device: bool = True
+) -> "tuple[binned_ops.RowSlice, list]":
     """Combine k host-plane ``EntriesMsg`` column dicts into ONE
     :class:`~delta_crdt_ex_tpu.ops.binned.RowSlice` — the ingress
     coalescing fan-in: instead of k sequential ``merge_rows`` dispatches,
@@ -236,7 +238,11 @@ def combine_entry_arrays(arrays_list: list) -> "tuple[binned_ops.RowSlice, list]
 
     Returns ``(slice, offsets)`` where ``offsets[i] = (lo, hi)`` is
     message i's row range in the combined slice (for per-message
-    accounting over the kernel's per-row counts).
+    accounting over the kernel's per-row counts). ``to_device=False``
+    keeps the combined columns as host numpy (the fleet scheduler
+    stacks many replicas' combined slices along a leading axis first
+    and moves the stack to device in one hop — see
+    :func:`stack_entry_slices`).
     """
     # union writer table, first-appearance order
     union_idx: dict[int, int] = {}
@@ -299,19 +305,110 @@ def combine_entry_arrays(arrays_list: list) -> "tuple[binned_ops.RowSlice, list]
             for c, v in cols.items()
         }
 
+    put = jnp.asarray if to_device else (lambda a: a)
     sl = binned_ops.RowSlice(
-        rows=jnp.asarray(rows),
-        key=jnp.asarray(cols["key"]),
-        valh=jnp.asarray(cols["valh"]),
-        ts=jnp.asarray(cols["ts"]),
-        node=jnp.asarray(node),
-        ctr=jnp.asarray(cols["ctr"]),
-        alive=jnp.asarray(cols["alive"]),
-        ctx_rows=jnp.asarray(ctx_rows),
-        ctx_lo=jnp.asarray(ctx_lo),
-        ctx_gid=jnp.asarray(ctx_gid),
+        rows=put(rows),
+        key=put(cols["key"]),
+        valh=put(cols["valh"]),
+        ts=put(cols["ts"]),
+        node=put(node),
+        ctr=put(cols["ctr"]),
+        alive=put(cols["alive"]),
+        ctx_rows=put(ctx_rows),
+        ctx_lo=put(ctx_lo),
+        ctx_gid=put(ctx_gid),
     )
     return sl, offsets
+
+
+#: RowSlice fields padded along the writer-table (Rr) axis by
+#: :func:`stack_entry_slices`'s ragged masking
+_SLICE_CTX_FIELDS = ("ctx_rows", "ctx_lo")
+
+
+def stack_entry_slices(
+    slices: list, lanes: int | None = None
+) -> "tuple[binned_ops.RowSlice, int]":
+    """``combine_entry_arrays`` generalised to a replica axis: stack k
+    per-replica combined slices (HOST numpy form, ``to_device=False``)
+    into one :class:`~delta_crdt_ex_tpu.ops.binned.RowSlice` with a
+    leading replica axis, for ONE ``fleet_merge_rows`` dispatch
+    (:mod:`delta_crdt_ex_tpu.runtime.transition`).
+
+    Ragged fan-in is handled by PER-REPLICA MASKING, not truncation:
+
+    - row counts pad to the stack's max row tier with ``-1`` rows (the
+      kernel's valid mask drops them — bit-for-bit no-ops);
+    - writer-table widths pad to the max with zero gids (zero = empty
+      slot: ``merge_gid_tables`` skips them and an all-zero interval
+      column claims nothing);
+    - entry-lane tiers (``key.shape[1]``) must be EQUAL — lane padding
+      would change the row-compact sort width and with it dead-slot
+      bytes, breaking bit parity (the same-lane-tier rule grouped
+      ingest already enforces); the fleet buckets unequal tiers into
+      separate dispatches instead.
+
+    ``lanes`` pads the REPLICA axis (compile-shape tiering for the
+    batched dispatch) with all-padding lanes that merge nothing; padded
+    lanes replicate lane 0's geometry. Returns ``(stacked slice,
+    real_rows)`` where ``real_rows`` counts non-padding bucket rows
+    across real lanes — the ragged-mask fill-ratio numerator.
+    """
+    n = len(slices)
+    lanes = n if lanes is None else lanes
+    s_widths = {s.key.shape[1] for s in slices}
+    if len(s_widths) > 1:
+        raise ValueError(f"unequal entry-lane tiers in one stack: {s_widths}")
+    u_to = max(s.rows.shape[0] for s in slices)
+    rp_to = max(s.ctx_gid.shape[0] for s in slices)
+    real_rows = 0
+
+    def pad(sl: binned_ops.RowSlice) -> binned_ops.RowSlice:
+        du = u_to - sl.rows.shape[0]
+        drp = rp_to - sl.ctx_gid.shape[0]
+        out = {}
+        for c in binned_ops.RowSlice._fields:
+            a = np.asarray(getattr(sl, c))
+            if c == "rows":
+                if du:
+                    a = np.concatenate([a, np.full(du, -1, a.dtype)])
+            elif c == "ctx_gid":
+                if drp:
+                    a = np.concatenate([a, np.zeros(drp, a.dtype)])
+            else:
+                if c in _SLICE_CTX_FIELDS and drp:
+                    a = np.concatenate(
+                        [a, np.zeros((a.shape[0], drp), a.dtype)], axis=1
+                    )
+                if du:
+                    a = np.concatenate(
+                        [a, np.zeros((du,) + a.shape[1:], a.dtype)]
+                    )
+            out[c] = a
+        return binned_ops.RowSlice(**out)
+
+    padded = []
+    for sl in slices:
+        rows = np.asarray(sl.rows)
+        real_rows += int((rows >= 0).sum())
+        padded.append(pad(sl))
+    if lanes > n:
+        blank = binned_ops.RowSlice(
+            rows=np.full(u_to, -1, np.int32),
+            **{
+                c: np.zeros_like(np.asarray(getattr(padded[0], c)))
+                for c in binned_ops.RowSlice._fields
+                if c != "rows"
+            },
+        )
+        padded.extend([blank] * (lanes - n))
+    stacked = binned_ops.RowSlice(
+        **{
+            c: jnp.asarray(np.stack([np.asarray(getattr(s, c)) for s in padded]))
+            for c in binned_ops.RowSlice._fields
+        }
+    )
+    return stacked, real_rows
 
 
 def merge_group_into(state: BinnedStore, arrays_list: list, on_grow=None):

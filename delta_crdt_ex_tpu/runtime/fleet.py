@@ -1,0 +1,458 @@
+"""Batched replica fleets — one process, thousands of replicas (ISSUE 6).
+
+One :class:`~delta_crdt_ex_tpu.runtime.replica.Replica` today is one
+Python event loop plus one device program per merge, so the ROADMAP's
+"millions of users" north star priced out as millions of processes. A
+:class:`Fleet` is the next altitude of PR 3's ingress coalescing:
+where the replica loop groups messages *within* one mailbox into one
+grouped dispatch, the fleet groups *across* replicas — it owns N
+member replicas, drains all N mailboxes per :meth:`tick`, and joins
+every member's coalesce groups with ONE vmapped kernel dispatch over a
+leading replica axis (DrJAX-style map/reduce,
+:func:`delta_crdt_ex_tpu.runtime.transition.fleet_merge_rows`).
+
+Semantics are bit-for-bit the solo replica's (the acceptance gate of
+``tests/test_fleet.py``):
+
+- grouping reuses each member's own ``_coalesce_groups`` pass, so the
+  per-replica combined slices are exactly what the solo grouped path
+  would merge;
+- ``vmap`` adds no arithmetic — lane k of the batched kernel IS the
+  solo kernel on lane k's inputs;
+- WAL records, acks, diff-subscriber work, seq numbering, and
+  telemetry fan back out per replica through the SAME bookkeeping tail
+  as the solo grouped path (``Replica._commit_entries_group``).
+
+Scheduling is wave-ordered: each member's drained mailbox partitions
+into units (a coalesce group, or a single non-entries message) and the
+fleet processes wave w of every member before wave w+1 — per-member
+arrival order is preserved exactly (a ``Down`` never passes entries
+from the same peer), while cross-member units of one wave share a
+dispatch.
+
+Batch formation buckets staged groups by compatible shapes — state
+geometry ``(L, B, R)`` and entry-lane tier S — and pads the ragged
+axes per replica (row counts with ``-1`` rows, writer-table widths
+with zero gids, the replica axis to a pow2 lane tier with all-padding
+lanes) so unequal fan-in still batches by group
+(:func:`delta_crdt_ex_tpu.models.binned_map.stack_entry_slices`).
+Everything a batch cannot carry keeps the existing per-replica
+fallback: bucket-of-one groups, device-plane slices, diff
+subscribers, growth/gap escapes (per-lane ``ok`` flags), and
+stale-version conflicts (staging is optimistic — a member mutated
+between staging and commit replays solo).
+
+The member states of a stable batch stay RESIDENT as the stacked
+result of the previous dispatch (``Replica.state`` materialises a
+lane only when something per-replica actually reads it), so the
+steady-state hot path does no per-replica stacking or unstacking —
+the host orchestrates, the device sees one launch per wave bucket.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+import jax
+
+from delta_crdt_ex_tpu.models.binned import pow2_tier
+from delta_crdt_ex_tpu.models.binned_map import stack_entry_slices
+from delta_crdt_ex_tpu.runtime import sync as sync_proto, telemetry, transition
+from delta_crdt_ex_tpu.runtime.replica import Replica
+
+
+class _Staged:
+    """One member's staged coalesce group, awaiting a batched dispatch."""
+
+    __slots__ = ("rep", "msgs", "sl", "offsets", "version", "key")
+
+    def __init__(self, rep, msgs, sl, offsets, version, geometry):
+        self.rep = rep
+        self.msgs = msgs
+        self.sl = sl
+        self.offsets = offsets
+        self.version = version
+        # batch-compat bucket: identical state geometry and entry-lane
+        # tier; row counts and writer-table widths may be ragged (the
+        # stack pads them per replica)
+        self.key = geometry + (sl.key.shape[1],)
+
+
+class Fleet:
+    """Scheduler owning N member replicas' event loops.
+
+    Members must be UNTHREADED (``threaded=False`` /
+    ``Replica.start()`` never called) — the fleet is their event loop.
+    Deterministic drives call :meth:`tick` / :meth:`drain`; production
+    use calls :meth:`start` for one background thread serving all N
+    members' periodic duties (sync ticks, WAL group-commit cadence,
+    interval checkpoints) plus the batched ingress drain.
+    """
+
+    def __init__(self, replicas: list, *, min_batch: int = 2):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        for r in replicas:
+            if not isinstance(r, Replica):
+                raise TypeError(f"not a Replica: {r!r}")
+            if r._thread is not None:
+                raise ValueError(
+                    f"replica {r.name!r} runs its own event loop; fleet "
+                    "members must be started with threaded=False"
+                )
+            if r._in_fleet:
+                raise ValueError(
+                    f"replica {r.name!r} already belongs to a fleet; two "
+                    "fleets draining one mailbox would race (the same "
+                    "hazard Replica.start() refuses for members)"
+                )
+        self.replicas = list(replicas)
+        #: smallest batch worth stacking: below it the per-replica
+        #: grouped path is strictly cheaper (nothing to amortise)
+        self.min_batch = max(2, int(min_batch))
+        self._lock = threading.Lock()
+        #: resident stacked states per batch bucket: members tuple →
+        #: (per-member state versions at stack time, stacked pytree,
+        #: lane tier). Reused while no member's state moved outside the
+        #: batched dispatch; conservatively dropped whenever any lane
+        #: fell back mid-dispatch (its lane in the result is stale).
+        self._stack_cache: dict = {}
+        self._stack_cache_cap = 32
+        # observability (ISSUE 6 satellite): occupancy, ragged fill,
+        # ticks/sec — the production-visible counterpart of the bench
+        self._ticks = 0
+        self._tick_time = 0.0
+        self._dispatches = 0
+        self._batched_messages = 0
+        self._occupancy_hist: dict[int, int] = {}
+        self._real_rows = 0
+        self._padded_rows = 0
+        self._fallbacks = {"singleton": 0, "shape": 0, "escape": 0, "stale": 0}
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+        for r in self.replicas:
+            # member notify() wakes the FLEET loop, not a per-replica one
+            r.notify = self._member_notify  # type: ignore[method-assign]
+            r._in_fleet = True
+
+    def _member_notify(self) -> None:
+        if self._thread is not None:
+            self._wake.set()
+
+    # ------------------------------------------------------------------
+    # ingress: drain all mailboxes, dispatch in waves
+
+    def tick(self) -> int:
+        """Drain one bounded batch from every member's mailbox and
+        handle it — batched where compatible, per-replica everywhere
+        else. Returns messages handled. Bounded per call exactly like
+        ``Replica.process_pending``'s single drain: sustained ingress
+        cannot starve the periodic duties between ticks."""
+        t0 = time.perf_counter()
+        per_member: list = []
+        n_msgs = 0
+        for rep in self.replicas:
+            drain = getattr(rep.transport, "drain_nowait", None)
+            batch = (
+                drain(rep.addr, rep.ingress_batch)
+                if drain is not None
+                else rep.transport.drain(rep.addr)
+            )
+            if batch:
+                n_msgs += len(batch)
+                per_member.append((rep, self._units(rep, batch)))
+        wave = 0
+        while True:
+            pairs = []
+            busy = False
+            for rep, units in per_member:
+                if wave >= len(units):
+                    continue
+                busy = True
+                kind, payload = units[wave]
+                if kind == "group":
+                    pairs.append((rep, payload))
+                else:
+                    rep.handle(payload)
+            if not busy:
+                break
+            if pairs:
+                self._dispatch_wave(pairs)
+            wave += 1
+        if n_msgs:
+            self._ticks += 1
+            self._tick_time += time.perf_counter() - t0
+        return n_msgs
+
+    def drain(self, max_rounds: int = 10_000) -> int:
+        """Deterministic drive: tick until every mailbox is empty."""
+        total = 0
+        for _ in range(max_rounds):
+            n = self.tick()
+            if n == 0:
+                return total
+            total += n
+        raise RuntimeError("fleet did not quiesce")
+
+    def _units(self, rep, batch: list) -> list:
+        """Partition one member's drained batch into ordered units —
+        the fleet-side mirror of ``Replica._handle_batch``: consecutive
+        ``EntriesMsg`` runs become coalesce groups (the member's OWN
+        grouping pass, so fleet and solo group identically); every
+        other message is a unit of its own, handled in place."""
+        if not rep.ingress_coalesce or rep.on_diffs is not None:
+            return [("msg", m) for m in batch]
+        units: list = []
+        run: list = []
+        for m in batch:
+            if isinstance(m, sync_proto.EntriesMsg):
+                run.append(m)
+                continue
+            if run:
+                units += [("group", g) for g in rep._coalesce_groups(run)]
+                run = []
+            units.append(("msg", m))
+        if run:
+            units += [("group", g) for g in rep._coalesce_groups(run)]
+        return units
+
+    def _solo(self, rep, msgs: list, reason: str) -> None:
+        self._fallbacks[reason] += 1
+        rep.fleet_handle_group(msgs)
+
+    def _dispatch_wave(self, pairs: list) -> None:
+        """Stage every (member, group) of one wave, bucket by shape
+        compatibility, and launch one batched dispatch per bucket."""
+        buckets: dict[tuple, list] = {}
+        for rep, msgs in pairs:
+            prep = rep.fleet_prepare(msgs)
+            if prep is None:
+                self._solo(rep, msgs, "shape")
+                continue
+            sl, offsets, version, geometry = prep
+            staged = _Staged(rep, msgs, sl, offsets, version, geometry)
+            buckets.setdefault(staged.key, []).append(staged)
+        for members in buckets.values():
+            if len(members) < self.min_batch:
+                for st in members:
+                    self._solo(st.rep, st.msgs, "singleton")
+                continue
+            self._dispatch_bucket(members)
+
+    def _stacked_states(self, reps: list, lanes: int):
+        """The stacked input states for one bucket — reused from the
+        previous dispatch's RESULT when no member's state moved since
+        (``_state_version`` match), else restacked from the members'
+        per-replica states. Padding lanes replicate member 0 (their
+        slices are all-padding: the merge is a no-op on them)."""
+        key = tuple(id(r) for r in reps) + (lanes,)
+        versions = [r._state_version for r in reps]
+        hit = self._stack_cache.get(key)
+        if hit is not None and hit[0] == versions:
+            return hit[1], key, versions
+        states = [r.state for r in reps]
+        states += [states[0]] * (lanes - len(states))
+        return transition.stack_states(states), key, versions
+
+    def _dispatch_bucket(self, members: list) -> None:
+        t0 = time.perf_counter()
+        n = len(members)
+        lanes = pow2_tier(n, floor=2)
+        sl, real_rows = stack_entry_slices([st.sl for st in members], lanes=lanes)
+        reps = [st.rep for st in members]
+        stacked_in, cache_key, _versions = self._stacked_states(reps, lanes)
+        res = transition.jit_fleet_merge_rows(stacked_in, sl)
+        ok, n_killed = jax.device_get((res.ok, res.n_killed))
+        dt = time.perf_counter() - t0
+        # per-row count readback is lazy and shared: one device_get for
+        # the whole stack, paid only if any SYNC_DONE handler exists
+        counts_cell: list = []
+
+        def counts_for(lane):
+            def fn():
+                if not counts_cell:
+                    counts_cell.append(
+                        jax.device_get((res.n_ins_row, res.n_kill_row))
+                    )
+                ins, kill = counts_cell[0]
+                return ins[lane], kill[lane]
+
+            return fn
+
+        all_committed = True
+        committed = 0
+        committed_versions: list[int] = []
+        for lane, st in enumerate(members):
+            if not bool(ok[lane]):
+                # growth/gap escape: the solo path owns retry tiers and
+                # the CtxGapError partition/repair machinery
+                all_committed = False
+                self._solo(st.rep, st.msgs, "escape")
+                continue
+            new_version = st.rep.fleet_commit(
+                st.msgs,
+                st.offsets,
+                res.state,
+                lane,
+                counts_for(lane),
+                int(n_killed[lane]),
+                dt / n,
+                st.version,
+            )
+            if new_version is not None:
+                committed += 1
+                committed_versions.append(new_version)
+            else:
+                # the member mutated between staging and commit: the
+                # batched merge read a stale state — replay solo
+                all_committed = False
+                self._solo(st.rep, st.msgs, "stale")
+        if all_committed:
+            # the result stack becomes the members' resident state: the
+            # next tick with unchanged versions reuses it, unstacked
+            # lanes are never materialised on the batch hot path. The
+            # recorded versions are the COMMIT-returned ones — a re-read
+            # here could race a concurrent mutation and mask it.
+            self._stack_cache[cache_key] = (committed_versions, res.state)
+            while len(self._stack_cache) > self._stack_cache_cap:
+                self._stack_cache.pop(next(iter(self._stack_cache)))
+        else:
+            # a fallen-back lane's row in the result is stale — never
+            # serve it as a materialisation source
+            self._stack_cache.pop(cache_key, None)
+        self._dispatches += 1
+        self._batched_messages += sum(len(st.msgs) for st in members)
+        self._occupancy_hist[committed] = (
+            self._occupancy_hist.get(committed, 0) + 1
+        )
+        self._real_rows += real_rows
+        self._padded_rows += lanes * int(sl.rows.shape[1])
+        if telemetry.has_handlers(telemetry.FLEET_DISPATCH):
+            telemetry.execute(
+                telemetry.FLEET_DISPATCH,
+                {
+                    "replicas": n,
+                    "lanes": lanes,
+                    "messages": sum(len(st.msgs) for st in members),
+                    "rows": real_rows,
+                    "padded_rows": lanes * int(sl.rows.shape[1]),
+                    "duration_s": dt,
+                },
+                {"fleet": id(self)},
+            )
+
+    # ------------------------------------------------------------------
+    # periodic duties + the one-thread event loop
+
+    def run_duties(self, now: float | None = None) -> None:
+        """One pass of every member's periodic duties — the per-replica
+        loop body of ``Replica.start``, hoisted so N members share one
+        thread."""
+        now = time.monotonic() if now is None else now
+        for rep in self.replicas:
+            with rep._lock:
+                if rep._pending:
+                    rep._flush()
+            nxt = getattr(rep, "_fleet_next_sync", 0.0)
+            if now >= nxt:
+                rep.sync_to_all()
+                rep._fleet_next_sync = now + rep.sync_interval
+            if rep.storage_mode == "interval" and rep.storage_module is not None:
+                nxt = getattr(rep, "_fleet_next_ckpt", None)
+                if nxt is None:
+                    # first sight: schedule one interval out, matching
+                    # the solo loop (an immediate checkpoint for every
+                    # member would stall the shared thread at start)
+                    rep._fleet_next_ckpt = now + rep.checkpoint_interval
+                elif now >= nxt:
+                    rep.checkpoint()
+                    rep._fleet_next_ckpt = now + rep.checkpoint_interval
+            with rep._lock:
+                # deferred interval-mode fsync, same contract as the
+                # solo event loop: the fd is replica-lock-serialised
+                # state, and the None check sits under the lock too
+                if rep._wal is not None:
+                    rep._wal.maybe_sync()
+
+    def start(self) -> "Fleet":
+        """Run the fleet's event loop in ONE background thread serving
+        every member — the served-users-per-host lever: thread count no
+        longer scales with replica count."""
+        if self._thread is not None:
+            return self
+
+        self._stop.clear()
+        min_interval = min(r.sync_interval for r in self.replicas)
+
+        def loop():
+            while not self._stop.is_set():
+                self.tick()
+                self.run_duties()
+                self._wake.wait(timeout=min(min_interval, 0.05))
+                self._wake.clear()
+
+        self._thread = threading.Thread(
+            target=loop, name=f"crdt-fleet-{id(self):x}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the loop and every member (best-effort final sync +
+        WAL close, the solo ``Replica.stop`` contract per member). Each
+        member's goodbye sync is DRAINED before the next member stops:
+        in the solo topology surviving members' own loops merge a
+        stopping peer's final push — here the fleet is that loop, so it
+        must serve the push before the recipients close their WALs."""
+        if self._thread is not None:
+            self._stop.set()
+            self._wake.set()
+            self._thread.join(timeout=5)
+            self._thread = None
+        for rep in self.replicas:
+            rep.stop()
+            self.drain()  # surviving members process the goodbye sync
+
+    # ------------------------------------------------------------------
+    # observability (ISSUE 6 satellite)
+
+    def stats(self) -> dict:
+        """Fleet-level dispatch observability: batched-dispatch
+        occupancy (replicas per launch), ragged-mask fill ratio, and
+        tick throughput — the ``INGEST_COALESCE``-histogram pattern one
+        altitude up."""
+        occ = dict(sorted(self._occupancy_hist.items()))
+        total = sum(occ.values())
+        return {
+            "replicas": len(self.replicas),
+            "ticks": self._ticks,
+            "ticks_per_sec": (
+                round(self._ticks / self._tick_time, 3) if self._tick_time else 0.0
+            ),
+            "dispatches": self._dispatches,
+            "batched_messages": self._batched_messages,
+            "occupancy_hist": occ,
+            "avg_occupancy": (
+                round(sum(k * v for k, v in occ.items()) / total, 3)
+                if total
+                else 0.0
+            ),
+            "ragged_fill_ratio": (
+                round(self._real_rows / self._padded_rows, 4)
+                if self._padded_rows
+                else 0.0
+            ),
+            "fallbacks": dict(self._fallbacks),
+        }
+
+
+def start_fleet(replicas: list, *, threaded: bool = True, **opts) -> Fleet:
+    """Wrap unthreaded replicas in a :class:`Fleet`; ``threaded=True``
+    starts the single shared event loop."""
+    fleet = Fleet(replicas, **opts)
+    if threaded:
+        fleet.start()
+    return fleet

@@ -48,7 +48,12 @@ from delta_crdt_ex_tpu.utils.hashing import (
 from delta_crdt_ex_tpu.models.binned import BinnedStore, pow2_tier, pow4_tier
 from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap, CtxGapError
 from delta_crdt_ex_tpu.ops.apply import OP_ADD, OP_CLEAR, OP_PAD, OP_REMOVE
-from delta_crdt_ex_tpu.runtime import sync as sync_proto, telemetry, tracing
+from delta_crdt_ex_tpu.runtime import (
+    sync as sync_proto,
+    telemetry,
+    tracing,
+    transition,
+)
 from delta_crdt_ex_tpu.runtime.clock import Clock
 from delta_crdt_ex_tpu.runtime.storage import (
     FileStorage,
@@ -205,6 +210,27 @@ class Replica:
 
         self.eager_deltas = eager_deltas
         self._lock = threading.RLock()
+        #: state cell behind the ``state`` property: ``_state`` is the
+        #: materialised per-replica pytree, or None while the
+        #: authoritative copy is a lane of a fleet's stacked batch
+        #: result (``_fleet_src = (stacked, lane)`` — materialised
+        #: lazily on first access). ``_state_version`` bumps on every
+        #: assignment: the fleet's batched dispatch is optimistic, and a
+        #: version that moved between staging and commit means the
+        #: batch read a stale state and must be replayed solo.
+        self._state: Any = None
+        self._fleet_src: "tuple | None" = None
+        self._state_version = 0
+        #: fleet participation counters (stats()["fleet"], mirroring
+        #: the ingress coalescing surface): batched dispatches this
+        #: replica rode, messages merged in them, and solo fallbacks
+        #: (growth/gap/stale-version/device-plane reroutes)
+        self._fleet_dispatches = 0
+        self._fleet_messages = 0
+        self._fleet_fallbacks = 0
+        #: set by Fleet on membership: the fleet owns this replica's
+        #: event loop, so start() must refuse (two drains would race)
+        self._in_fleet = False
         self._pending: list[tuple[str, Any, Any]] = []  # (op, key_term, value)
         #: per-neighbour per-bucket own counter already pushed (Almeida's
         #: delta mode); soft state — reset on restart, pushes re-cover
@@ -393,6 +419,43 @@ class Replica:
 
         self.transport.register(self.name, self)
         self._warmup()
+
+    @property
+    def state(self) -> BinnedStore:
+        """The device-resident lattice state. For a fleet member the
+        authoritative copy may be a lane of the fleet's stacked batch
+        result (:meth:`fleet_commit`); the lane materialises as a solo
+        pytree on first access and is cached — fleet members whose
+        state is only ever merged by batched dispatches never pay a
+        per-replica unstack on the hot path. (The RLock is reentrant:
+        callers inside a locked region pay one no-op re-acquire.)"""
+        with self._lock:
+            if self._state is None:
+                stacked, lane = self._fleet_src
+                self._state = transition.index_state(stacked, lane)
+                self._fleet_src = None
+            return self._state
+
+    @state.setter
+    def state(self, value) -> None:
+        with self._lock:
+            self._state = value
+            self._fleet_src = None
+            self._state_version += 1
+
+    def _geometry(self) -> tuple:
+        """``(num_buckets, bin_capacity, replica_capacity)`` without
+        forcing a fleet-held lane to materialise (the fleet's shape
+        bucketing must stay free of device work)."""
+        if self._state is not None:
+            st = self._state
+            return (st.num_buckets, st.bin_capacity, st.replica_capacity)
+        stacked, _lane = self._fleet_src
+        return (
+            stacked.key.shape[1],
+            stacked.key.shape[2],
+            stacked.ctx_gid.shape[1],
+        )
 
     def _warmup(self) -> None:
         """Pre-trigger the jit compile of the single-op mutate tier so the
@@ -2308,9 +2371,40 @@ class Replica:
         self._tree = None
         self._read_cache = None
         self._read_cache_kh = None
+        self._commit_entries_group(
+            msgs,
+            offsets,
+            lambda: jax.device_get((res.n_ins_row, res.n_kill_row)),
+            dt,
+        )
+        if telemetry.has_handlers(telemetry.INGEST_COALESCE):
+            telemetry.execute(
+                telemetry.INGEST_COALESCE,
+                {
+                    "depth": depth,
+                    "rows": int(offsets[-1][1]),
+                    "entries": sum(len(m.payloads) for m in msgs),
+                    "duration_s": dt,
+                },
+                {"name": self.name},
+            )
+        self._gc_pressure += sum(len(m.payloads) for m in msgs) + int(res.n_killed)
+        self._maybe_gc()
+
+    def _commit_entries_group(self, msgs: list, offsets, counts_fn, dt: float) -> None:
+        """Per-message bookkeeping for one grouped entries dispatch —
+        THE shared tail of the in-replica grouped path and the fleet's
+        cross-replica batched path, so sequence numbering, SYNC_DONE /
+        SYNC_ROUND streams, and WAL record bytes cannot drift between
+        them (the fleet-vs-solo bit-for-bit parity contract).
+        ``counts_fn`` lazily yields the kernel's per-row (insert, kill)
+        count arrays — a device readback only SYNC_DONE handlers pay
+        for. Caller holds the lock, has stored the merged state, and
+        has invalidated the tree/read caches."""
+        depth = len(msgs)
         want_done = telemetry.has_handlers(telemetry.SYNC_DONE)
         if want_done:
-            ins_row, kill_row = jax.device_get((res.n_ins_row, res.n_kill_row))
+            ins_row, kill_row = counts_fn()
         for i, m in enumerate(msgs):
             self._seq += 1
             if want_done:
@@ -2342,19 +2436,90 @@ class Replica:
                     "payloads": dict(payloads),
                 }
             )
-        if telemetry.has_handlers(telemetry.INGEST_COALESCE):
-            telemetry.execute(
-                telemetry.INGEST_COALESCE,
-                {
-                    "depth": depth,
-                    "rows": int(offsets[-1][1]),
-                    "entries": sum(len(m.payloads) for m in msgs),
-                    "duration_s": dt,
-                },
-                {"name": self.name},
+
+    # -- batched replica fleets (ISSUE 6 tentpole) -----------------------
+    #
+    # A fleet (runtime/fleet.py) drains many replicas' mailboxes per
+    # tick and joins their coalesce groups with ONE vmapped kernel
+    # dispatch over a leading replica axis (runtime/transition.py).
+    # These hooks are the replica's side of that contract — the
+    # cross-class API the fleet drives, public-named so the lock
+    # analysis treats them as externally-entered units: staging is
+    # optimistic (no lock held across the batched dispatch), and the
+    # commit replays through the same bookkeeping tail as the solo
+    # grouped path — observable behaviour (state bits, WAL bytes, seq,
+    # acks) is identical to handling the messages without a fleet.
+
+    def fleet_prepare(self, msgs: list) -> "tuple | None":
+        """Stage one coalesce group for a fleet batched dispatch: flush
+        pending local ops, register the group's payloads (idempotent —
+        the solo fallback re-registers harmlessly), and combine the
+        group into one host-form slice. Returns ``(slice, offsets,
+        state_version, geometry)`` or ``None`` to demand the
+        per-replica fallback — a diff subscriber (the before/after
+        winner compare is defined per slice) or device-plane slices
+        (combining happens on host), exactly the solo grouped path's
+        exclusions."""
+        if self.on_diffs is not None:
+            return None
+        for m in msgs:
+            if not isinstance(m.arrays["key"], np.ndarray):
+                return None
+        with self._lock:
+            self._flush()
+            for m in msgs:
+                self._register_slice_payloads(m.payloads)
+            sl, offsets = self.model.combine_entry_arrays(
+                [m.arrays for m in msgs], to_device=False
             )
-        self._gc_pressure += sum(len(m.payloads) for m in msgs) + int(res.n_killed)
-        self._maybe_gc()
+            return sl, offsets, self._state_version, self._geometry()
+
+    def fleet_handle_group(self, msgs: list) -> None:
+        """Per-replica fallback for one fleet group: the solo grouped
+        dispatch under this replica's own lock — growth tiers, the
+        ``CtxGapError`` partition/repair, and singleton handling all
+        behave exactly as without a fleet."""
+        with self._lock:
+            self._fleet_fallbacks += 1
+            self._handle_entries_group(msgs)
+
+    def fleet_commit(
+        self,
+        msgs: list,
+        offsets,
+        stacked,
+        lane: int,
+        counts_fn,
+        n_killed: int,
+        dt: float,
+        version: int,
+    ) -> "int | None":
+        """Adopt lane ``lane`` of a fleet batched dispatch's stacked
+        result and fan out the per-message bookkeeping (seq, telemetry,
+        WAL records, gc pressure). Returns the NEW state version (the
+        one at which ``stacked[lane]`` is this replica's state — the
+        fleet's residency cache must record exactly this version, not a
+        later re-read that could mask a concurrent mutation), or
+        ``None`` — leaving this replica untouched, the fleet replays
+        the group solo — when the state moved since
+        :meth:`fleet_prepare` staged it (the batched merge then read a
+        stale state)."""
+        with self._lock:
+            if self._state_version != version:
+                return None
+            self._state = None
+            self._fleet_src = (stacked, lane)
+            self._state_version += 1
+            committed_version = self._state_version
+            self._tree = None
+            self._read_cache = None
+            self._read_cache_kh = None
+            self._fleet_dispatches += 1
+            self._fleet_messages += len(msgs)
+            self._commit_entries_group(msgs, offsets, counts_fn, dt)
+            self._gc_pressure += sum(len(m.payloads) for m in msgs) + n_killed
+            self._maybe_gc()
+            return committed_version
 
     def _merge_with_growth(self, sl):
         # row-granular merge: runtime slices are ≤ max_sync_size rows,
@@ -2540,6 +2705,11 @@ class Replica:
                     "gap_fallbacks": self._ingress_gap_fallbacks,
                     "gap_partitions": self._ingress_gap_partitions,
                 },
+                "fleet": {
+                    "dispatches": self._fleet_dispatches,
+                    "batched_messages": self._fleet_messages,
+                    "fallbacks": self._fleet_fallbacks,
+                },
                 "catchup": {
                     "chunks_served": self._catchup_chunks_served,
                     "chunks_applied": self._catchup_chunks_applied,
@@ -2566,6 +2736,11 @@ class Replica:
         """Run the periodic anti-entropy loop in a background thread
         (reference: ``send_after(self(), :sync, interval)``,
         ``causal_crdt.ex:180-186``; first sync fires immediately, ``:46``)."""
+        if self._in_fleet:
+            raise ValueError(
+                f"replica {self.name!r} is a fleet member; the fleet owns "
+                "its event loop (two drains of one mailbox would race)"
+            )
         if self._thread is not None:
             return self
         self._stop.clear()

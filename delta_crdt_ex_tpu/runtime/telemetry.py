@@ -22,6 +22,7 @@ WAL_COMPACT = ("delta_crdt", "wal", "compact")  # measurements: segments_deleted
 WAL_RECOVER = ("delta_crdt", "wal", "recover")  # measurements: records, bytes, duration_s
 CATCHUP_CHUNK = ("delta_crdt", "catchup", "chunk")  # measurements: records, rows, entries, bytes, duration_s; metadata: name, role ("server"|"client"), peer
 CATCHUP_DONE = ("delta_crdt", "catchup", "done")  # measurements: chunks, duration_s, horizon_fallback; metadata: name, peer
+FLEET_DISPATCH = ("delta_crdt", "fleet", "dispatch")  # measurements: replicas, lanes, messages, rows, padded_rows, duration_s; metadata: fleet
 
 _lock = threading.Lock()
 _handlers: dict[tuple, list[Callable]] = defaultdict(list)

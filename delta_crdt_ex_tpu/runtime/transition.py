@@ -1,0 +1,109 @@
+"""Pure, jit-able replica state transitions — the device half of the
+replica split (ISSUE 6).
+
+:mod:`delta_crdt_ex_tpu.runtime.replica` is two layers fused: a pure
+state transition (merge/join/compact/read over the binned store) and an
+I/O shell (locks, WAL, transport, payload dicts, telemetry). This module
+is the *pure* layer factored out, generalised over a leading replica
+axis: every function here is a deterministic function of its array
+inputs — no locks, no WAL, no transport, no host syncs — and is safe to
+``jax.jit``/``jax.vmap``/``shard_map``. crdtlint enforces the contract
+structurally: every function in this module is host-sync-checked as a
+jit entry point (SYNC001) and the lattice ops are purity-checked
+(PURE001–003).
+
+The fleet forms (``fleet_*``) are the DrJAX-style map/reduce shape over
+a leading replica axis (PAPERS.md): N replica states stacked along axis
+0 ride ONE batched dispatch instead of N host round-trips — the
+single-process thousands-of-replicas scheduler
+(:mod:`delta_crdt_ex_tpu.runtime.fleet`) drains N mailboxes per tick
+into :func:`fleet_merge_rows`. ``vmap`` adds no arithmetic of its own
+(integer/bool lattice math batches element-exact), so a vmapped lane is
+bit-for-bit the solo kernel on that lane's inputs — the property
+``tests/test_fleet.py`` pins.
+
+Stacking helpers (:func:`stack_states`, :func:`index_state`) are pure
+pytree shuffles and live here so the shell never touches array layout.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from delta_crdt_ex_tpu.models.binned import BinnedStore
+from delta_crdt_ex_tpu.ops import binned as binned_ops
+
+# ---------------------------------------------------------------------------
+# single-replica transitions (the replica loop's device calls, re-exported
+# here as THE pure seam — the shell may only reach the store through these
+# or through models/binned_map.py's tiered wrappers)
+
+merge_rows = binned_ops.merge_rows
+row_apply = binned_ops.row_apply
+extract_rows = binned_ops.extract_rows
+compact_rows = binned_ops.compact_rows
+winner_all = binned_ops.winner_all
+
+
+# ---------------------------------------------------------------------------
+# fleet transitions: leading replica axis, one dispatch for N replicas
+
+
+def fleet_merge_rows(states: BinnedStore, slices) -> binned_ops.MergeRowsResult:
+    """Batched anti-entropy merge: lane k joins ``slices`` lane k into
+    ``states`` lane k (:func:`~delta_crdt_ex_tpu.ops.binned.merge_rows`
+    over a leading replica axis). Every result field gains the leading
+    axis — per-lane ``ok``/``need_*`` flags let the host shell retry
+    only the overflowing lanes through the solo growth path, and
+    per-lane ``gap_row`` masks keep the ``CtxGapError`` repair
+    per-sender. Padding lanes (rows all ``-1``) merge nothing and
+    report ``ok``."""
+    return jax.vmap(binned_ops.merge_rows)(states, slices)
+
+
+def fleet_row_apply(states, self_slots, rows, op, key, valh, ts):
+    """Batched local mutation: lane k applies its bucket-grouped batch
+    to ``states`` lane k (:func:`~delta_crdt_ex_tpu.ops.binned.row_apply`
+    over a leading replica axis)."""
+    return jax.vmap(binned_ops.row_apply)(
+        states, self_slots, rows, op, key, valh, ts
+    )
+
+
+def fleet_extract_rows(states, rows) -> binned_ops.RowSlice:
+    """Batched sync-slice extraction: lane k gathers its own ``rows``
+    lane (``-1`` pads) — the fleet-wide eager-push gather."""
+    return jax.vmap(binned_ops.extract_rows)(states, rows)
+
+
+def fleet_compact_rows(states: BinnedStore) -> BinnedStore:
+    """Batched full repack + invariant rebuild, one dispatch for the
+    whole stack."""
+    return jax.vmap(binned_ops.compact_rows)(states)
+
+
+def fleet_winner_all(states: BinnedStore) -> binned_ops.RowWinners:
+    """Batched whole-table LWW winner resolution (the fleet read path)."""
+    return jax.vmap(binned_ops.winner_all)(states)
+
+
+jit_fleet_merge_rows = jax.jit(fleet_merge_rows)
+jit_fleet_row_apply = jax.jit(fleet_row_apply)
+jit_fleet_extract_rows = jax.jit(fleet_extract_rows)
+jit_fleet_compact_rows = jax.jit(fleet_compact_rows)
+jit_fleet_winner_all = jax.jit(fleet_winner_all)
+
+
+# ---------------------------------------------------------------------------
+# stacking (pure pytree shuffles — no host round trips)
+
+
+def stack_states(states: list) -> BinnedStore:
+    """Stack per-replica states (identical geometry) along a new leading
+    replica axis — the fleet's resident form."""
+    return jax.tree.map(lambda *xs: jax.numpy.stack(xs), *states)
+
+
+def index_state(stacked: BinnedStore, lane: int) -> BinnedStore:
+    """Lane ``lane`` of a stacked fleet state as a solo state pytree."""
+    return jax.tree.map(lambda a: a[lane], stacked)

@@ -1648,6 +1648,57 @@ def test_mutation_invented_wal_kind_is_caught():
     assert any(f.rule == "WAL002" and "'tombstone'" in f.message for f in new)
 
 
+def test_mutation_host_sync_in_fleet_transition_is_caught():
+    """Acceptance (ISSUE 6): an injected host sync in the fleet's pure
+    batched-transition path turns the gate red (SYNC001) — every
+    function in ``runtime/transition.py`` is a jit entry root by
+    contract, so the leak is caught even with no caller jit-wrapping
+    the mutated function."""
+    rel = f"{PKG}/runtime/transition.py"
+    anchor = "    return jax.vmap(binned_ops.merge_rows)(states, slices)"
+    assert anchor in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(
+            anchor, "    _n = states.fill.sum().item()\n" + anchor, 1
+        ),
+    )
+    assert any(
+        f.rule == "SYNC001" and f.path.endswith("runtime/transition.py")
+        for f in new
+    )
+    # int() coercion of a traced value is the same leak class
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(
+            anchor, "    _n = int(states.fill.sum())\n" + anchor, 1
+        ),
+    )
+    assert any(
+        f.rule == "SYNC001" and f.path.endswith("runtime/transition.py")
+        for f in new
+    )
+
+
+def test_mutation_impure_fleet_transition_is_caught():
+    """An in-place argument mutation (PURE001) or a clock read
+    (PURE003) injected into the fleet merge transition turns the gate
+    red — the vmapped lattice ops are purity-scoped like ops/ and
+    models/ joins."""
+    rel = f"{PKG}/runtime/transition.py"
+    anchor = "    return jax.vmap(binned_ops.merge_rows)(states, slices)"
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(anchor, "    states.key = slices\n" + anchor, 1),
+    )
+    assert any(f.rule == "PURE001" for f in new)
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(anchor, "    _t = time.time()\n" + anchor, 1),
+    )
+    assert any(f.rule == "PURE003" for f in new)
+
+
 def test_mutation_stale_allow_is_caught():
     """A freshly stale allow comment (rule fixed, comment left behind)
     turns the gate red (SUPPRESS001)."""

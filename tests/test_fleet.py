@@ -1,0 +1,591 @@
+"""Batched replica fleets (ISSUE 6): one vmapped dispatch serving N
+replicas must be OBSERVABLY IDENTICAL to N solo replicas — bit-for-bit
+state arrays, byte-identical WAL contents, and the same outbound
+protocol traffic (acks included) — while launching far fewer kernels.
+
+Covers the pure-transition kernel parity (vmap lane == solo kernel,
+ragged masking included), the runtime fleet-vs-solo parity on seeded
+randomized gossip scripts (state + WAL bytes + ack streams), the
+fallback paths (growth escape, ctx-gap repair, device-plane slices,
+stale-version optimistic-concurrency replay), the observability
+surface, and the threaded ``start_fleet`` end-to-end loop.
+"""
+
+import dataclasses
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from delta_crdt_ex_tpu import AWLWWMap
+from delta_crdt_ex_tpu.api import start_fleet, start_link
+from delta_crdt_ex_tpu.models.binned import BinnedStore
+from delta_crdt_ex_tpu.models.binned_map import (
+    combine_entry_arrays,
+    stack_entry_slices,
+)
+from delta_crdt_ex_tpu.ops.binned import RowSlice, extract_rows, merge_rows
+from delta_crdt_ex_tpu.runtime import sync as sync_proto, telemetry, transition
+from delta_crdt_ex_tpu.runtime.clock import LogicalClock
+from delta_crdt_ex_tpu.runtime.fleet import Fleet
+from delta_crdt_ex_tpu.runtime.transport import LocalTransport
+from tests.test_ingest_coalesce import (
+    _wal_segment_bytes,
+    assert_state_bit_equal,
+    entries_only,
+    keys_for_buckets,
+)
+
+_COLS = tuple(f.name for f in dataclasses.fields(BinnedStore))
+
+
+# ---------------------------------------------------------------------------
+# pure-transition kernel parity: vmap lane == solo kernel, bit-for-bit
+
+
+def _np_slice(sl: RowSlice) -> RowSlice:
+    return RowSlice(**{c: np.asarray(getattr(sl, c)) for c in RowSlice._fields})
+
+
+def _mk_states_and_slices(n, seed=0, rows_per=None):
+    """n (target state, incoming slice) pairs with per-pair writers and
+    overlapping keys so merges exercise inserts AND kills."""
+    from tests.kernel_harness import BinnedKernelMap
+
+    L = 16
+    rng = np.random.default_rng(seed)
+    states, slices = [], []
+    for i in range(n):
+        tgt = BinnedKernelMap(gid=100 + i, capacity=128, rcap=8, num_buckets=L)
+        src = BinnedKernelMap(gid=500 + i, capacity=128, rcap=8, num_buckets=L)
+        ks = keys_for_buckets(0, L, 5, mask=L - 1, start=1000 * i)
+        for ts, k in enumerate(ks, start=1):
+            src.add(k, int(rng.integers(0, 100)), ts=ts)
+        for ts, k in enumerate(ks[:2], start=10):  # kill-pass prey
+            tgt.add(k, 7, ts=ts)
+        nrows = rows_per[i] if rows_per else L
+        rows = jnp.asarray(np.arange(nrows, dtype=np.int32))
+        states.append(tgt.state)
+        slices.append(extract_rows(src.state, rows))
+    return states, slices
+
+
+def test_fleet_merge_rows_vmap_lane_equals_solo_kernel():
+    """The tentpole property: lane k of one batched ``fleet_merge_rows``
+    dispatch is bit-for-bit the solo ``merge_rows`` on lane k's inputs —
+    every state column, dead slots included, plus the per-row counts."""
+    n = 3
+    states, slices = _mk_states_and_slices(n, seed=1)
+    solo = [merge_rows(st, sl) for st, sl in zip(states, slices)]
+    assert all(bool(r.ok) for r in solo)
+
+    stacked_sl, _ = stack_entry_slices([_np_slice(s) for s in slices])
+    res = transition.jit_fleet_merge_rows(
+        transition.stack_states(states), stacked_sl
+    )
+    assert np.asarray(res.ok).all()
+    for k in range(n):
+        lane = transition.index_state(res.state, k)
+        assert_state_bit_equal(solo[k].state, lane, f"lane {k}")
+        assert np.array_equal(
+            np.asarray(res.n_ins_row)[k], np.asarray(solo[k].n_ins_row)
+        )
+        assert np.array_equal(
+            np.asarray(res.n_kill_row)[k], np.asarray(solo[k].n_kill_row)
+        )
+
+
+def test_fleet_merge_rows_ragged_masking_and_padding_lanes():
+    """Ragged fan-in: lanes with fewer rows pad with -1 rows and lanes
+    past the real member count are all-padding — both must merge as
+    exact no-ops (bit parity for the real lanes, input state returned
+    for padding lanes)."""
+    n = 2
+    states, slices = _mk_states_and_slices(n, seed=2, rows_per=[16, 4])
+    solo = [merge_rows(st, sl) for st, sl in zip(states, slices)]
+
+    np_slices = [_np_slice(s) for s in slices]
+    stacked_sl, real_rows = stack_entry_slices(np_slices, lanes=4)
+    assert real_rows == 16 + 4
+    assert stacked_sl.rows.shape == (4, 16)  # ragged rows padded to max
+    stacked_states = transition.stack_states(
+        states + [states[0], states[0]]  # padding lanes replicate lane 0
+    )
+    res = transition.jit_fleet_merge_rows(stacked_states, stacked_sl)
+    assert np.asarray(res.ok).all()
+    for k in range(n):
+        assert_state_bit_equal(
+            solo[k].state, transition.index_state(res.state, k), f"lane {k}"
+        )
+    for k in (2, 3):  # all-padding lanes: exact no-op on the input state
+        assert_state_bit_equal(
+            states[0], transition.index_state(res.state, k), f"pad lane {k}"
+        )
+        assert int(np.asarray(res.n_inserted)[k]) == 0
+        assert int(np.asarray(res.n_killed)[k]) == 0
+
+
+def test_stack_entry_slices_rejects_unequal_lane_tiers():
+    states, slices = _mk_states_and_slices(2, seed=3)
+    a = _np_slice(slices[0])
+    widened = RowSlice(
+        **{
+            **{c: np.asarray(getattr(a, c)) for c in RowSlice._fields},
+            **{
+                c: np.concatenate(
+                    [np.asarray(getattr(a, c))] * 2, axis=1
+                )
+                for c in ("key", "valh", "ts", "node", "ctr", "alive")
+            },
+        }
+    )
+    with pytest.raises(ValueError, match="lane tiers"):
+        stack_entry_slices([a, widened])
+
+
+def test_stack_entry_slices_pads_ragged_writer_tables():
+    """Unequal ctx widths pad with zero gids — empty slots that claim
+    nothing (the per-replica masking half of ragged fan-in)."""
+    states, slices = _mk_states_and_slices(2, seed=4)
+    a, b = (_np_slice(s) for s in slices)
+    # narrow b's writer table to its 1 nonzero gid + 1 pad column
+    nz = np.asarray(b.ctx_gid) != 0
+    keep = max(int(nz.sum()), 1) + 1
+    b = RowSlice(
+        **{
+            **{c: np.asarray(getattr(b, c)) for c in RowSlice._fields},
+            "ctx_gid": np.asarray(b.ctx_gid)[:keep],
+            "ctx_rows": np.asarray(b.ctx_rows)[:, :keep],
+            "ctx_lo": np.asarray(b.ctx_lo)[:, :keep],
+        }
+    )
+    stacked, _ = stack_entry_slices([a, b])
+    assert stacked.ctx_gid.shape == (2, np.asarray(a.ctx_gid).shape[0])
+    # the padded columns are all-zero gids claiming nothing
+    gids_b = np.asarray(stacked.ctx_gid)[1]
+    assert (gids_b[keep:] == 0).all()
+    res = transition.jit_fleet_merge_rows(
+        transition.stack_states(states), stacked
+    )
+    assert np.asarray(res.ok).all()
+    solo = [merge_rows(st, sl) for st, sl in zip(states, slices)]
+    for k in range(2):
+        assert_state_bit_equal(
+            solo[k].state, transition.index_state(res.state, k), f"lane {k}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# runtime parity: fleet vs N solo replicas, identical streams
+
+
+def _mk_sender(transport, clock, i, **opts):
+    return start_link(
+        AWLWWMap,
+        threaded=False,
+        transport=transport,
+        clock=clock,
+        capacity=64,
+        tree_depth=6,
+        name=f"fs{i}",
+        **opts,
+    )
+
+
+def _mk_pairs(transport, clock, n, tmp=None, **opts):
+    """n fleet receivers + n solo receivers, pairwise-equal node ids so
+    their states are bit-comparable; optional per-member WALs."""
+    wal = lambda tag, i: (
+        {"wal_dir": str(tmp / f"{tag}{i}"), "fsync_mode": "none"} if tmp else {}
+    )
+    fleet = Fleet(
+        [
+            start_link(
+                AWLWWMap, threaded=False, transport=transport, clock=clock,
+                capacity=64, tree_depth=6, node_id=1000 + i, name=f"ff{i}",
+                **wal("f", i), **opts,
+            )
+            for i in range(n)
+        ]
+    )
+    solos = [
+        start_link(
+            AWLWWMap, threaded=False, transport=transport, clock=clock,
+            capacity=64, tree_depth=6, node_id=1000 + i, name=f"fo{i}",
+            **wal("o", i), **opts,
+        )
+        for i in range(n)
+    ]
+    return fleet, solos
+
+
+def _norm_msg(m, addr_map):
+    """Wire-normal form of an outbound protocol message for stream
+    comparison: type name + payload fields, receiver addresses replaced
+    by pair-invariant tokens."""
+    sub = lambda v: addr_map.get(v, v)
+    t = type(m).__name__
+    if isinstance(m, sync_proto.AckMsg):
+        return (t, sub(m.clear_addr))
+    if isinstance(m, sync_proto.DiffMsg):
+        return (
+            t, sub(m.originator), sub(m.frm), m.level, m.idx.tolist(),
+            [b.tolist() for b in m.blocks], m.seq, m.log_horizon,
+        )
+    if isinstance(m, sync_proto.GetDiffMsg):
+        return (t, sub(m.originator), sub(m.frm), np.asarray(m.buckets).tolist())
+    if isinstance(m, sync_proto.GetLogMsg):
+        return (t, sub(m.frm), m.last_seq, m.applied_seq)
+    return (t, repr(m))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fleet_vs_solo_bit_for_bit_parity_randomized(seed, tmp_path):
+    """THE acceptance property (ISSUE 6): seeded randomized gossip
+    scripts fed identically to a fleet and to N solo replicas end with
+    bit-identical states, sequence numbers, byte-identical WAL segment
+    contents, and identical outbound protocol streams (acks included) —
+    while the fleet actually batched across replicas."""
+    rng = np.random.default_rng(seed)
+    transport = LocalTransport()
+    clock = LogicalClock()
+    n = 3
+    senders = [_mk_sender(transport, clock, i) for i in range(n)]
+    fleet, solos = _mk_pairs(transport, clock, n, tmp=tmp_path)
+    for i, s in enumerate(senders):
+        s.set_neighbours([fleet.replicas[i], solos[i]])
+    addr_map = {}
+    for i in range(n):
+        addr_map[fleet.replicas[i].addr] = f"recv{i}"
+        addr_map[solos[i].addr] = f"recv{i}"
+
+    done: list = []
+    handler = lambda _e, meas, meta: done.append(
+        (meta["name"], meas["keys_updated_count"])
+    )
+    telemetry.attach(telemetry.SYNC_DONE, handler)
+    try:
+        for _round in range(int(rng.integers(2, 5))):
+            for _ in range(int(rng.integers(1, 10))):
+                i = int(rng.integers(0, n))
+                ki = int(rng.integers(0, 12))
+                if rng.random() < 0.7:
+                    senders[i].mutate("add", [ki, int(rng.integers(0, 100))])
+                else:
+                    senders[i].mutate("remove", [ki])
+            for s in senders:
+                s.sync_to_all()
+            fleet.drain()
+            for r in solos:
+                r.process_pending()
+            # walk replies / acks flow back: compare each sender's
+            # per-receiver stream, fleet vs solo — byte-normal equal
+            for i, s in enumerate(senders):
+                back = transport.drain(s.addr)
+                from_f = [
+                    _norm_msg(m, addr_map)
+                    for m in back
+                    if getattr(m, "frm", getattr(m, "clear_addr", None))
+                    in (fleet.replicas[i].addr,)
+                    or getattr(m, "clear_addr", None) == fleet.replicas[i].addr
+                ]
+                from_s = [
+                    _norm_msg(m, addr_map)
+                    for m in back
+                    if getattr(m, "frm", getattr(m, "clear_addr", None))
+                    in (solos[i].addr,)
+                    or getattr(m, "clear_addr", None) == solos[i].addr
+                ]
+                assert from_f == from_s, (seed, i)
+    finally:
+        telemetry.detach(telemetry.SYNC_DONE, handler)
+
+    for i in range(n):
+        rf, rs = fleet.replicas[i], solos[i]
+        assert rf.read() == rs.read()
+        assert rf._seq == rs._seq
+        assert_state_bit_equal(rf.state, rs.state, (seed, i))
+        assert _wal_segment_bytes(rf) == _wal_segment_bytes(rs)
+        # per-message SYNC_DONE parity, pairwise
+        assert [c for nme, c in done if nme == rf.name] == [
+            c for nme, c in done if nme == rs.name
+        ], (seed, i)
+
+
+def test_fleet_batches_across_replicas_and_counts(tmp_path):
+    """The fleet must actually batch: one wave of N singleton groups
+    rides ONE vmapped dispatch (occupancy N), and the observability
+    surfaces (fleet stats, member stats, FLEET_DISPATCH telemetry)
+    agree."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    n = 4
+    senders = [_mk_sender(transport, clock, i) for i in range(n)]
+    fleet, solos = _mk_pairs(transport, clock, n)
+    for i, s in enumerate(senders):
+        s.set_neighbours([fleet.replicas[i]])
+
+    events = []
+    handler = lambda _e, meas, _m: events.append(meas)
+    telemetry.attach(telemetry.FLEET_DISPATCH, handler)
+    try:
+        for i, s in enumerate(senders):
+            for k in keys_for_buckets(0, 64, 3, start=777 * i):
+                s.mutate("add", [k, k])
+            s.sync_to_all()
+        for r in fleet.replicas:
+            entries_only(transport, r.addr)
+        fleet.drain()
+    finally:
+        telemetry.detach(telemetry.FLEET_DISPATCH, handler)
+
+    st = fleet.stats()
+    assert st["dispatches"] == 1
+    assert st["occupancy_hist"] == {n: 1}
+    assert st["avg_occupancy"] == n
+    assert st["batched_messages"] == n
+    assert 0 < st["ragged_fill_ratio"] <= 1
+    assert st["ticks"] >= 1 and st["ticks_per_sec"] > 0
+    for r in fleet.replicas:
+        assert r.stats()["fleet"] == {
+            "dispatches": 1,
+            "batched_messages": 1,
+            "fallbacks": 0,
+        }
+        assert len(r.read()) == 3
+    assert len(events) == 1 and events[0]["replicas"] == n
+    assert events[0]["rows"] <= events[0]["padded_rows"]
+
+
+def test_fleet_growth_escape_falls_back_solo(tmp_path):
+    """A member whose bin tier overflows mid-batch (need_fill_grow)
+    must fall back to the solo growth path while clean members keep the
+    batched result — end states still match the solo universe."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    n = 2
+    senders = [_mk_sender(transport, clock, i) for i in range(n)]
+    # tiny bins: 64 capacity / 64 buckets → 4-slot bins (the floor).
+    # Each sender writes >4 same-bucket keys: its own bin grows to 8
+    # (equal S=8 slices, so the two groups share one batch bucket) and
+    # the receivers' 4-slot bins overflow mid-batch → need_fill_grow
+    fleet, solos = _mk_pairs(transport, clock, n)
+    for i, s in enumerate(senders):
+        s.set_neighbours([fleet.replicas[i], solos[i]])
+    for k in keys_for_buckets(3, 4, 6, start=0):
+        senders[0].mutate("add", [k, "x"])
+    for k in keys_for_buckets(40, 41, 5, start=50_000):
+        senders[1].mutate("add", [k, "y"])
+    for s in senders:
+        s.sync_to_all()
+    for r in list(fleet.replicas) + solos:
+        entries_only(transport, r.addr)
+    fleet.drain()
+    for r in solos:
+        r.process_pending()
+    st = fleet.stats()
+    assert st["dispatches"] == 1  # the batch WAS launched...
+    assert st["fallbacks"]["escape"] == 2  # ...and both lanes escaped
+    for i in range(n):
+        assert fleet.replicas[i].read() == solos[i].read()
+        assert_state_bit_equal(fleet.replicas[i].state, solos[i].state, i)
+
+
+def test_fleet_gap_partitions_and_repairs_like_solo(tmp_path):
+    """A lost earlier push gaps one member's group mid-batch: the
+    escape fallback must route through the solo gap machinery — the
+    gapped source gets its GetDiffMsg repair, clean members commit the
+    batch, and post-repair states match solo bit-for-bit."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    n = 2
+    senders = [_mk_sender(transport, clock, i) for i in range(n)]
+    fleet, solos = _mk_pairs(transport, clock, n)
+    for i, s in enumerate(senders):
+        s.set_neighbours([fleet.replicas[i], solos[i]])
+
+    k1a, k1b = keys_for_buckets(3, 4, 2)
+    senders[0].mutate("add", [k1a, "one"])
+    senders[0].sync_to_all()
+    for r in list(fleet.replicas) + solos:
+        transport.drain(r.addr)  # the push is LOST everywhere
+
+    senders[0].mutate("add", [k1b, "two"])  # same bucket: interval gaps
+    (k2,) = keys_for_buckets(40, 48, 1)
+    senders[1].mutate("add", [k2, "other"])
+    for s in senders:
+        s.sync_to_all()
+    for r in list(fleet.replicas) + solos:
+        entries_only(transport, r.addr)
+    fleet.drain()
+    for r in solos:
+        r.process_pending()
+
+    assert fleet.stats()["fallbacks"]["escape"] >= 1
+    gets = [
+        m
+        for m in transport.drain(senders[0].addr)
+        if isinstance(m, sync_proto.GetDiffMsg)
+    ]
+    assert sorted(m.frm for m in gets) == sorted(
+        [fleet.replicas[0].addr, solos[0].addr]
+    )
+    for m in gets:
+        senders[0].handle(m)  # repair
+    for r in list(fleet.replicas) + solos:
+        entries_only(transport, r.addr)
+    fleet.drain()
+    for r in solos:
+        r.process_pending()
+    for i in range(n):
+        assert fleet.replicas[i].read() == solos[i].read()
+        assert_state_bit_equal(fleet.replicas[i].state, solos[i].state, i)
+
+
+def test_fleet_device_plane_slices_keep_solo_path():
+    """Device-plane slices (non-numpy columns) must never enter the
+    host-side batch — they reroute through the per-replica path."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    senders = [_mk_sender(transport, clock, i) for i in range(2)]
+    fleet, _ = _mk_pairs(transport, clock, 2)
+    for i, s in enumerate(senders):
+        s.set_neighbours([fleet.replicas[i]])
+    for i, s in enumerate(senders):
+        s.mutate("add", [keys_for_buckets(0, 64, 1, start=i * 999)[0], i])
+        s.sync_to_all()
+    # re-plane every queued EntriesMsg onto the device data plane
+    for r in fleet.replicas:
+        msgs = transport.drain(r.addr)
+        for m in msgs:
+            if isinstance(m, sync_proto.EntriesMsg):
+                m.arrays = {
+                    c: (jnp.asarray(v) if c != "rows" else v)
+                    for c, v in m.arrays.items()
+                }
+            transport.send(r.addr, m)
+    fleet.drain()
+    assert fleet.stats()["fallbacks"]["shape"] >= 1 or (
+        fleet.stats()["fallbacks"]["singleton"] >= 1
+    )
+    for i, r in enumerate(fleet.replicas):
+        assert len(r.read()) == 1
+
+
+def test_fleet_stale_version_refuses_commit():
+    """Optimistic concurrency: a member whose state moved between
+    staging and commit must refuse the batched result (the merge read a
+    stale state) and leave the replica untouched."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    s = _mk_sender(transport, clock, 0)
+    fleet, _ = _mk_pairs(transport, clock, 2)
+    rep = fleet.replicas[0]
+    s.set_neighbours([rep])
+    s.mutate("add", [keys_for_buckets(0, 64, 1)[0], "v"])
+    s.sync_to_all()
+    msgs = [
+        m
+        for m in transport.drain(rep.addr)
+        if isinstance(m, sync_proto.EntriesMsg)
+    ]
+    assert msgs
+    prep = rep.fleet_prepare(msgs)
+    assert prep is not None
+    _sl, offsets, version, _geom = prep
+    rep.mutate("add", [keys_for_buckets(0, 64, 1, start=12345)[0], "w"])
+    seq_before = rep._seq
+    assert not rep.fleet_commit(
+        msgs, offsets, None, 0, lambda: (None, None), 0, 0.0, version
+    )
+    assert rep._seq == seq_before  # untouched: the fleet replays solo
+
+
+def test_fleet_rejects_threaded_members():
+    transport = LocalTransport()
+    clock = LogicalClock()
+    r = _mk_sender(transport, clock, 0)
+    r.start()
+    try:
+        with pytest.raises(ValueError, match="threaded=False"):
+            Fleet([r])
+    finally:
+        r.stop()
+    with pytest.raises(ValueError, match="at least one"):
+        Fleet([])
+    # and the inverse: a fleet member must not start its own loop
+    r2 = _mk_sender(transport, clock, 99)
+    Fleet([r2, _mk_sender(transport, clock, 98)])
+    with pytest.raises(ValueError, match="fleet member"):
+        r2.start()
+    # nor join a second fleet (two drains of one mailbox race)
+    with pytest.raises(ValueError, match="already belongs"):
+        Fleet([r2, _mk_sender(transport, clock, 97)])
+
+
+def test_start_fleet_threaded_end_to_end():
+    """The api entry point: a threaded fleet of mutually-syncing
+    members converges through its single shared event loop."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    fleet = start_fleet(
+        3,
+        transport=transport,
+        clock=clock,
+        capacity=64,
+        tree_depth=6,
+        sync_interval=0.02,
+        names=["fa", "fb", "fc"],
+    )
+    try:
+        a, b, c = fleet.replicas
+        for r in fleet.replicas:
+            r.set_neighbours([x for x in fleet.replicas if x is not r])
+
+        def converged(want):
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if all(r.read() == want for r in fleet.replicas):
+                    return True
+                time.sleep(0.02)
+            return False
+
+        a.mutate("add", ["k1", 1])
+        b.mutate("add", ["k2", 2])
+        assert converged({"k1": 1, "k2": 2})
+        # c has OBSERVED k1 now, so its remove wins everywhere
+        c.mutate("remove", ["k1"])
+        assert converged({"k2": 2})
+        assert fleet.stats()["ticks"] >= 1
+    finally:
+        fleet.stop()
+
+
+def test_fleet_member_wal_recovery_round_trip(tmp_path):
+    """A fleet member's WAL is the ordinary per-replica WAL: crash and
+    restart with the same name + wal_dir rehydrates the merged state."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    s = _mk_sender(transport, clock, 0)
+    fleet, _ = _mk_pairs(transport, clock, 2, tmp=tmp_path)
+    rep = fleet.replicas[0]
+    s.set_neighbours([rep])
+    keys = keys_for_buckets(0, 64, 4)
+    for k in keys:
+        s.mutate("add", [k, f"v{k}"])
+    s.sync_to_all()
+    entries_only(transport, rep.addr)
+    fleet.drain()
+    want = rep.read()
+    assert len(want) == 4
+    node_id = rep.node_id
+    rep.crash()
+    reborn = start_link(
+        AWLWWMap, threaded=False, transport=transport, clock=clock,
+        capacity=64, tree_depth=6, name=rep.name,
+        wal_dir=str(tmp_path / "f0"), fsync_mode="none",
+    )
+    assert reborn.node_id == node_id
+    assert reborn.read() == want
+    reborn.crash()

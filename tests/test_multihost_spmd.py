@@ -12,6 +12,29 @@ import subprocess
 import sys
 import time
 
+import pytest
+
+#: minimal reproduction of the capability the real test needs: two
+#: jax.distributed processes running ONE global (cross-process) jitted
+#: computation on the forced-CPU backend. Some jaxlib builds reject this
+#: outright ("Multiprocess computations aren't implemented on the CPU
+#: backend") — an environment property, not a code regression, so the
+#: real test must skip (not fail) there.
+PROBE = r"""
+import sys
+pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+import jax
+jax.distributed.initialize(coordinator_address=coord, num_processes=nproc, process_id=pid)
+import numpy as np
+from jax.experimental import multihost_utils
+assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+# a global computation spanning both processes' devices — the exact
+# operation class the gossip drive's ppermutes need
+out = multihost_utils.process_allgather(np.int32(pid), tiled=False)
+assert sorted(np.asarray(out).ravel().tolist()) == list(range(nproc))
+print("PROBE_OK", flush=True)
+"""
+
 WORKER = r"""
 import dataclasses, os, sys
 pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
@@ -112,10 +135,7 @@ def _free_port():
     return port
 
 
-def test_two_process_global_mesh_gossip(tmp_path):
-    script = tmp_path / "worker.py"
-    script.write_text(WORKER)
-    coord = f"127.0.0.1:{_free_port()}"
+def _worker_env() -> dict:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PALLAS_AXON_POOL_IPS"] = ""
@@ -130,6 +150,13 @@ def test_two_process_global_mesh_gossip(tmp_path):
         flags = f"{flags} --{flag}=4".strip()
     env["XLA_FLAGS"] = flags
     env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _run_pair(script: str, timeout_s: float) -> list:
+    """Spawn the two-process worker pair; returns [(rc, out, err), ...]."""
+    coord = f"127.0.0.1:{_free_port()}"
+    env = _worker_env()
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(pid), "2", coord],
@@ -138,18 +165,59 @@ def test_two_process_global_mesh_gossip(tmp_path):
         for pid in range(2)
     ]
     try:
-        deadline = time.monotonic() + 240
+        deadline = time.monotonic() + timeout_s
         outs = []
         for p in procs:
             remaining = max(5.0, deadline - time.monotonic())
             out, err = p.communicate(timeout=remaining)
             outs.append((p.returncode, out, err))
-        for rc, out, err in outs:
-            assert rc == 0 and "MULTIHOST_OK" in out, f"worker failed: {err[-3000:]}"
-        # both controllers computed the same converged digest root
-        roots = {o.split("roots=")[1].split()[0] for _, o, _ in outs}
-        assert len(roots) == 1, roots
+        return outs
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+#: probe verdict cache: None = not yet probed, else (ok, reason)
+_PROBE_RESULT: "tuple[bool, str] | None" = None
+
+
+def _global_cpu_mesh_capability(tmp_path) -> "tuple[bool, str]":
+    """Can this container run a cross-process global computation on the
+    forced-CPU backend? Probed ONCE per session with a minimal two-
+    process allgather; failures return the diagnostic line so the skip
+    reason is honest about what the environment refused."""
+    global _PROBE_RESULT
+    if _PROBE_RESULT is None:
+        script = tmp_path / "probe.py"
+        script.write_text(PROBE)
+        try:
+            outs = _run_pair(script, timeout_s=120)
+        except subprocess.TimeoutExpired:
+            _PROBE_RESULT = (False, "capability probe timed out")
+            return _PROBE_RESULT
+        bad = [(rc, err) for rc, out, err in outs if rc != 0 or "PROBE_OK" not in out]
+        if bad:
+            rc, err = bad[0]
+            tail = err.strip().splitlines()[-1] if err.strip() else f"exit {rc}"
+            _PROBE_RESULT = (False, tail[-300:])
+        else:
+            _PROBE_RESULT = (True, "")
+    return _PROBE_RESULT
+
+
+def test_two_process_global_mesh_gossip(tmp_path):
+    ok, why = _global_cpu_mesh_capability(tmp_path)
+    if not ok:
+        pytest.skip(
+            "container cannot form a two-process global CPU mesh "
+            f"(probe: {why})"
+        )
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    outs = _run_pair(script, timeout_s=240)
+    for rc, out, err in outs:
+        assert rc == 0 and "MULTIHOST_OK" in out, f"worker failed: {err[-3000:]}"
+    # both controllers computed the same converged digest root
+    roots = {o.split("roots=")[1].split()[0] for _, o, _ in outs}
+    assert len(roots) == 1, roots

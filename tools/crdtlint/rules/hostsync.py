@@ -20,6 +20,12 @@ lose exactly the overlap they exist for).
   leave synchronisation to the caller/bench harness, or carry an
   explicit ``# crdtlint: allow[host-sync]`` justification.
 
+Pure-transition modules (``runtime/transition*`` — the replica split's
+device half, ISSUE 6) are jit-reachable BY CONTRACT: every function
+defined there is treated as a jit entry root whether or not something
+currently wraps it, so a host sync snuck into the fleet's batched
+transition path turns the gate red even before any caller traces it.
+
 ``int()``/``float()`` on static-shape arithmetic (constants, ``len()``,
 ``.shape``/``.ndim``/``.size`` reads) is exempt — those are Python
 values at trace time, not device reads.
@@ -39,6 +45,11 @@ _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
 _JIT_NAMES = {"jit"}
 _ENTRY_WRAPPERS = {"shard_map", "pallas_call", "pmap"}
 _OP_MODULE_MARKERS = (".ops.", ".parallel.")
+#: modules whose every function is a jit entry root by contract (the
+#: pure state-transition layer of the replica split — "jit-able, no
+#: host syncs" is its definition, so the gate must not depend on some
+#: caller happening to wrap each function today)
+_TRANSITION_MODULE_MARKERS = (".runtime.transition",)
 
 
 def _is_jit_call(node: ast.Call) -> bool:
@@ -107,6 +118,12 @@ def _reachable_functions(project: Project) -> set[int]:
             resolved = project.resolve_function(mod, expr)
             if resolved is not None:
                 push(*resolved)
+        # pure-transition modules: every top-level function is an entry
+        # root by contract (see module docstring)
+        if any(m in mod.name + "." for m in _TRANSITION_MODULE_MARKERS):
+            for node in mod.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    push(mod, node)
 
     while work:
         mod, fn = work.pop()

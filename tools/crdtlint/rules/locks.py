@@ -366,11 +366,20 @@ class _ClassAnalysis:
         self.mod = mod
         self.node = node
         self.name = node.name
-        self.methods: dict[str, ast.FunctionDef] = {
-            n.name: n
-            for n in node.body
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-        }
+        # keyed by a UNIQUE unit name: a class may define several defs
+        # under one name (property getter + setter/deleter overloads) —
+        # a plain name-keyed dict would shadow all but the last, leaving
+        # e.g. a property getter's lock region entirely unanalysed
+        self.methods: dict[str, ast.FunctionDef] = {}
+        for n in node.body:
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            name = n.name
+            k = 2
+            while name in self.methods:
+                name = f"{n.name}#{k}"  # "#k" never collides with real names
+                k += 1
+            self.methods[name] = n
         self.lock_attrs = self._find_constructed(("Lock", "RLock"))
         self.exempt_attrs = self._find_constructed(tuple(THREADSAFE_CONSTRUCTORS))
         self.exempt_attrs |= self.lock_attrs

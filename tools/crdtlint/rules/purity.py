@@ -8,7 +8,10 @@ consults module state, or reads a clock produces states that diverge
 replica-to-replica in ways no test of a single replica will catch.
 
 Scope: functions (including methods) whose name contains a ``join``,
-``merge`` or ``delta`` token, in ``ops/`` and ``models/`` modules.
+``merge`` or ``delta`` token, in ``ops/`` and ``models/`` modules and
+in the pure-transition modules of the replica split
+(``runtime/transition*``, ISSUE 6 — the fleet's vmapped lattice ops
+are exactly the functions whose purity anti-entropy stakes itself on).
 
 - **PURE001** — argument mutation: assignment/del through a parameter
   (``arg.x = …``, ``arg[k] = …``) or an in-place mutator call on one
@@ -32,7 +35,7 @@ RULE_GLOBAL = "PURE002"
 RULE_IMPURE = "PURE003"
 
 _NAME_RE = re.compile(r"(^|_)(join|merge|delta)(_|$|s$)")
-_SCOPE_MARKERS = (".ops.", ".models.")
+_SCOPE_MARKERS = (".ops.", ".models.", ".runtime.transition")
 _IMPURE_ROOTS = {"time", "random", "secrets", "uuid"}
 _IMPURE_CHAINS = ("np.random.", "numpy.random.", "datetime.")
 
