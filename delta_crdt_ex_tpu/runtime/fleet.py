@@ -183,8 +183,12 @@ class Fleet:
                 self._dispatch_wave(pairs)
             wave += 1
         if n_msgs:
-            self._ticks += 1
-            self._tick_time += time.perf_counter() - t0
+            with self._lock:
+                # tick/dispatch counters are read by stats() from any
+                # caller thread while the fleet loop writes them
+                # (crdtlint RACE001)
+                self._ticks += 1
+                self._tick_time += time.perf_counter() - t0
         return n_msgs
 
     def drain(self, max_rounds: int = 10_000) -> int:
@@ -220,7 +224,8 @@ class Fleet:
         return units
 
     def _solo(self, rep, msgs: list, reason: str) -> None:
-        self._fallbacks[reason] += 1
+        with self._lock:
+            self._fallbacks[reason] += 1
         rep.fleet_handle_group(msgs)
 
     def _dispatch_wave(self, pairs: list) -> None:
@@ -250,7 +255,8 @@ class Fleet:
         slices are all-padding: the merge is a no-op on them)."""
         key = tuple(id(r) for r in reps) + (lanes,)
         versions = [r._state_version for r in reps]
-        hit = self._stack_cache.get(key)
+        with self._lock:
+            hit = self._stack_cache.get(key)
         if hit is not None and hit[0] == versions:
             return hit[1], key, versions
         states = [r.state for r in reps]
@@ -310,26 +316,28 @@ class Fleet:
                 # batched merge read a stale state — replay solo
                 all_committed = False
                 self._solo(st.rep, st.msgs, "stale")
-        if all_committed:
-            # the result stack becomes the members' resident state: the
-            # next tick with unchanged versions reuses it, unstacked
-            # lanes are never materialised on the batch hot path. The
-            # recorded versions are the COMMIT-returned ones — a re-read
-            # here could race a concurrent mutation and mask it.
-            self._stack_cache[cache_key] = (committed_versions, res.state)
-            while len(self._stack_cache) > self._stack_cache_cap:
-                self._stack_cache.pop(next(iter(self._stack_cache)))
-        else:
-            # a fallen-back lane's row in the result is stale — never
-            # serve it as a materialisation source
-            self._stack_cache.pop(cache_key, None)
-        self._dispatches += 1
-        self._batched_messages += sum(len(st.msgs) for st in members)
-        self._occupancy_hist[committed] = (
-            self._occupancy_hist.get(committed, 0) + 1
-        )
-        self._real_rows += real_rows
-        self._padded_rows += lanes * int(sl.rows.shape[1])
+        with self._lock:
+            if all_committed:
+                # the result stack becomes the members' resident state:
+                # the next tick with unchanged versions reuses it,
+                # unstacked lanes are never materialised on the batch
+                # hot path. The recorded versions are the
+                # COMMIT-returned ones — a re-read here could race a
+                # concurrent mutation and mask it.
+                self._stack_cache[cache_key] = (committed_versions, res.state)
+                while len(self._stack_cache) > self._stack_cache_cap:
+                    self._stack_cache.pop(next(iter(self._stack_cache)))
+            else:
+                # a fallen-back lane's row in the result is stale —
+                # never serve it as a materialisation source
+                self._stack_cache.pop(cache_key, None)
+            self._dispatches += 1
+            self._batched_messages += sum(len(st.msgs) for st in members)
+            self._occupancy_hist[committed] = (
+                self._occupancy_hist.get(committed, 0) + 1
+            )
+            self._real_rows += real_rows
+            self._padded_rows += lanes * int(sl.rows.shape[1])
         if telemetry.has_handlers(telemetry.FLEET_DISPATCH):
             telemetry.execute(
                 telemetry.FLEET_DISPATCH,
@@ -423,30 +431,34 @@ class Fleet:
         """Fleet-level dispatch observability: batched-dispatch
         occupancy (replicas per launch), ragged-mask fill ratio, and
         tick throughput — the ``INGEST_COALESCE``-histogram pattern one
-        altitude up."""
-        occ = dict(sorted(self._occupancy_hist.items()))
-        total = sum(occ.values())
-        return {
-            "replicas": len(self.replicas),
-            "ticks": self._ticks,
-            "ticks_per_sec": (
-                round(self._ticks / self._tick_time, 3) if self._tick_time else 0.0
-            ),
-            "dispatches": self._dispatches,
-            "batched_messages": self._batched_messages,
-            "occupancy_hist": occ,
-            "avg_occupancy": (
-                round(sum(k * v for k, v in occ.items()) / total, 3)
-                if total
-                else 0.0
-            ),
-            "ragged_fill_ratio": (
-                round(self._real_rows / self._padded_rows, 4)
-                if self._padded_rows
-                else 0.0
-            ),
-            "fallbacks": dict(self._fallbacks),
-        }
+        altitude up. Served under the fleet lock: the loop thread
+        updates every counter it reports (crdtlint RACE001/005)."""
+        with self._lock:
+            occ = dict(sorted(self._occupancy_hist.items()))
+            total = sum(occ.values())
+            return {
+                "replicas": len(self.replicas),
+                "ticks": self._ticks,
+                "ticks_per_sec": (
+                    round(self._ticks / self._tick_time, 3)
+                    if self._tick_time
+                    else 0.0
+                ),
+                "dispatches": self._dispatches,
+                "batched_messages": self._batched_messages,
+                "occupancy_hist": occ,
+                "avg_occupancy": (
+                    round(sum(k * v for k, v in occ.items()) / total, 3)
+                    if total
+                    else 0.0
+                ),
+                "ragged_fill_ratio": (
+                    round(self._real_rows / self._padded_rows, 4)
+                    if self._padded_rows
+                    else 0.0
+                ),
+                "fallbacks": dict(self._fallbacks),
+            }
 
 
 def start_fleet(replicas: list, *, threaded: bool = True, **opts) -> Fleet:

@@ -166,8 +166,11 @@ def test_lock_thread_entry_flagged(tmp_path):
         },
     )
     found = lint(pkg)
-    assert rules_of(found) == {"LOCK001"}
-    assert "_loop" in found[0].message
+    # the thread-entry read is both a discipline violation (LOCK001:
+    # guarded attr, unguarded path) and an actual race (RACE001: caller
+    # writes, thread reads, no common lock) — both families fire
+    assert rules_of(found) == {"LOCK001", "RACE001"}
+    assert any(f.rule == "LOCK001" and "_loop" in f.message for f in found)
 
 
 def test_lock_acquire_wrapper_recognised(tmp_path):
@@ -1715,3 +1718,642 @@ def test_mutation_stale_allow_is_caught():
         f.rule == "SUPPRESS001" and f.path.endswith("runtime/wal.py")
         for f in new
     )
+
+
+# ----------------------------------------------------------------------
+# RACE001–005 — happens-before race detection (fixtures)
+
+
+RACY_COUNTER = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._n = 0
+            self._thread = threading.Thread(target=self._loop)
+            self._thread.start()
+
+        def _loop(self):
+            while True:
+                self._n += 1
+
+        def size(self):
+            return self._n
+"""
+
+
+def test_race_cross_thread_counter_flagged(tmp_path):
+    """A completely lock-free cross-thread counter: LOCK001 is blind
+    (no lock anywhere means no guard to infer) — RACE001 is the rule
+    that sees it."""
+    pkg = make_pkg(tmp_path, {"box.py": RACY_COUNTER})
+    found = lint(pkg)
+    assert rules_of(found) == {"RACE001"}
+    assert any("_n" in f.message and "_loop" in f.message for f in found)
+
+
+def test_race_counter_with_common_lock_clean(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+                    self._thread = threading.Thread(target=self._loop)
+                    self._thread.start()
+
+                def _loop(self):
+                    while True:
+                        with self._lock:
+                            self._n += 1
+
+                def size(self):
+                    with self._lock:
+                        return self._n
+            """
+        },
+    )
+    assert lint(pkg) == []
+
+
+# -- happens-before edges, one fixture per edge kind -------------------
+
+
+START_EDGE = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._cfg = None
+
+        def start(self):
+            self._cfg = 42
+            self._thread = threading.Thread(target=self._loop)
+            self._thread.start()
+            {post}
+
+        def _loop(self):
+            print(self._cfg)
+"""
+
+
+def test_hb_start_edge_orders_pre_start_writes(tmp_path):
+    pkg = make_pkg(tmp_path, {"box.py": START_EDGE.format(post="return self")})
+    assert lint(pkg) == []
+
+
+def test_hb_write_after_start_is_published_race(tmp_path):
+    pkg = make_pkg(
+        tmp_path, {"box.py": START_EDGE.format(post="self._cfg = 43")}
+    )
+    found = lint(pkg)
+    assert "RACE004" in rules_of(found)
+    assert any(
+        f.rule == "RACE004" and "_cfg" in f.message and "_loop" in f.message
+        for f in found
+    )
+
+
+JOIN_EDGE = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._out = []
+            self._thread = threading.Thread(target=self._work)
+            self._thread.start()
+
+        def _work(self):
+            self._out.append(1)
+
+        def result(self):
+            {pre}return list(self._out)
+"""
+
+
+def test_hb_join_edge_orders_thread_writes(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {"box.py": JOIN_EDGE.format(pre="self._thread.join()\n            ")},
+    )
+    assert lint(pkg) == []
+
+
+def test_hb_missing_join_is_iteration_race(tmp_path):
+    pkg = make_pkg(tmp_path, {"box.py": JOIN_EDGE.format(pre="")})
+    found = lint(pkg)
+    assert rules_of(found) == {"RACE005"}
+    assert "_out" in found[0].message
+
+
+EVENT_EDGE = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._ready = threading.Event()
+            self._result = None
+            self._thread = threading.Thread(target=self._work)
+            self._thread.start()
+
+        def _work(self):
+            self._result = 41
+            self._ready.set()
+
+        def read(self):
+            self._ready.wait({timeout})
+            return self._result
+"""
+
+
+def test_hb_event_set_wait_edge_orders_handoff(tmp_path):
+    pkg = make_pkg(tmp_path, {"box.py": EVENT_EDGE.format(timeout="")})
+    assert lint(pkg) == []
+
+
+def test_hb_timed_wait_is_not_an_edge(tmp_path):
+    """``Event.wait(timeout)`` can return with nothing set — pacing,
+    not synchronisation. The same handoff with a timeout races."""
+    pkg = make_pkg(tmp_path, {"box.py": EVENT_EDGE.format(timeout="0.5")})
+    found = lint(pkg)
+    assert rules_of(found) == {"RACE001"}
+    assert any("_result" in f.message for f in found)
+
+
+QUEUE_EDGE = """
+    import queue
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._q = queue.Queue()
+            self._q2 = queue.Queue()
+            self._payload = None
+            self._thread = threading.Thread(target=self._work)
+            self._thread.start()
+
+        def _work(self):
+            self._payload = 7
+            self._q.put(None)
+
+        def read(self):
+            self._q{get_q}.get()
+            return self._payload
+"""
+
+
+def test_hb_queue_put_get_edge_orders_handoff(tmp_path):
+    pkg = make_pkg(tmp_path, {"box.py": QUEUE_EDGE.format(get_q="")})
+    assert lint(pkg) == []
+
+
+def test_hb_distinct_queues_do_not_synchronize(tmp_path):
+    """put on one queue object and get on ANOTHER is no handoff — the
+    HB channel is per-object, and blessing cross-queue pairs would hide
+    real races behind unrelated queue traffic."""
+    pkg = make_pkg(tmp_path, {"box.py": QUEUE_EDGE.format(get_q="2")})
+    found = lint(pkg)
+    assert rules_of(found) == {"RACE001"}
+    assert any("_payload" in f.message for f in found)
+
+
+# -- RACE002: closure escapes across the thread boundary ---------------
+
+
+ESCAPE = """
+    import threading
+
+    def collect():
+        acc = []
+
+        def fill():
+            acc.append(1)
+
+        t = threading.Thread(target=fill)
+        t.start()
+        {mid}
+        return list(acc)
+"""
+
+
+def test_race_closure_escape_flagged(tmp_path):
+    pkg = make_pkg(tmp_path, {"box.py": ESCAPE.format(mid="pass")})
+    found = lint(pkg)
+    assert rules_of(found) == {"RACE002"}
+    assert "'acc'" in found[0].message and "fill" in found[0].message
+
+
+def test_race_closure_escape_joined_clean(tmp_path):
+    pkg = make_pkg(tmp_path, {"box.py": ESCAPE.format(mid="t.join()")})
+    assert lint(pkg) == []
+
+
+def test_race_threadsafe_capture_clean(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": """
+            import queue
+            import threading
+
+            def collect():
+                acc = queue.Queue()
+
+                def fill():
+                    acc.put(1)
+
+                threading.Thread(target=fill).start()
+                return acc.get()
+            """
+        },
+    )
+    assert lint(pkg) == []
+
+
+# -- RACE003: check-then-act on version fields -------------------------
+
+
+VERSION_CHECK = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._ver = 0
+            self._val = None
+
+        def bump(self, v):
+            with self._lock:
+                self._val = v
+                self._ver += 1
+
+        def commit(self, expect, v):
+            {body}
+"""
+
+
+def test_race_version_check_outside_lock_flagged(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": VERSION_CHECK.format(
+                body=(
+                    "if self._ver != expect:\n"
+                    "                return False\n"
+                    "            with self._lock:\n"
+                    "                self._val = v\n"
+                    "            return True"
+                )
+            )
+        },
+    )
+    found = lint(pkg)
+    assert "RACE003" in rules_of(found)
+    assert any(
+        f.rule == "RACE003" and "_ver" in f.message and "commit" in f.message
+        for f in found
+    )
+
+
+def test_race_version_check_inside_lock_clean(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": VERSION_CHECK.format(
+                body=(
+                    "with self._lock:\n"
+                    "                if self._ver != expect:\n"
+                    "                    return False\n"
+                    "                self._val = v\n"
+                    "            return True"
+                )
+            )
+        },
+    )
+    assert lint(pkg) == []
+
+
+# -- RACE005: lock-free iteration --------------------------------------
+
+
+def test_race_unlocked_iteration_flagged(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._items = {}
+                    self._thread = threading.Thread(target=self._feed)
+                    self._thread.start()
+
+                def _feed(self):
+                    self._items[1] = 2
+
+                def keys(self):
+                    return [k for k in self._items]
+            """
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"RACE005"}
+    assert "_items" in found[0].message
+
+
+def test_race_locked_iteration_clean(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+                    self._thread = threading.Thread(target=self._feed)
+                    self._thread.start()
+
+                def _feed(self):
+                    with self._lock:
+                        self._items[1] = 2
+
+                def keys(self):
+                    with self._lock:
+                        return [k for k in self._items]
+            """
+        },
+    )
+    assert lint(pkg) == []
+
+
+# -- module globals (the telemetry/native shape) -----------------------
+
+
+MOD_GLOBAL = """
+    import threading
+
+    _cache = {{}}
+    _lock = threading.Lock()
+
+    def start_filler():
+        def fill():
+            {fill_body}
+
+        threading.Thread(target=fill, daemon=True).start()
+
+    def peek():
+        {peek_body}
+"""
+
+
+def test_race_module_global_cross_thread_flagged(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": MOD_GLOBAL.format(
+                fill_body="_cache[1] = 2",
+                peek_body="return _cache.get(1)",
+            )
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"RACE001"}
+    assert any("_cache" in f.message for f in found)
+
+
+def test_race_module_global_locked_clean(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": MOD_GLOBAL.format(
+                fill_body="with _lock:\n                _cache[1] = 2",
+                peek_body="with _lock:\n            return _cache.get(1)",
+            )
+        },
+    )
+    assert lint(pkg) == []
+
+
+# ----------------------------------------------------------------------
+# RACE mutation tests — ≥5 distinct injected races in the REAL tree
+# turn the gate red (engine overlay, working tree untouched)
+
+
+def test_mutation_deleted_lock_around_cross_thread_write_is_caught():
+    """Injected race 1: delete the ``with self._lock:`` around the
+    fleet tick counters — the loop thread then writes what stats()
+    reads with no common lock (RACE001; LOCK001 stays blind because the
+    attr no longer has a guarded write to infer a guard from)."""
+    rel = f"{PKG}/runtime/fleet.py"
+    anchor = (
+        "            with self._lock:\n"
+        "                # tick/dispatch counters are read by stats() from any\n"
+        "                # caller thread while the fleet loop writes them\n"
+        "                # (crdtlint RACE001)\n"
+    )
+    assert anchor in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel, lambda s: s.replace(anchor, "            if True:\n", 1)
+    )
+    assert any(
+        f.rule == "RACE001" and "_ticks" in f.message for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_event_wait_removed_before_dependent_read_is_caught():
+    """Injected race 2: a correct Event handoff added to the real Fleet
+    is green (the set→wait edge orders the publication); deleting the
+    ``wait()`` turns the same read into a race (RACE001). Proves the HB
+    edge is what suppresses — not an accident of the surrounding tree."""
+    rel = f"{PKG}/runtime/fleet.py"
+    probe = (
+        "    def probe_publish(self):\n"
+        "        self._probe_done = threading.Event()\n"
+        "        self._probe_box = {}\n"
+        "\n"
+        "        def probe_fill():\n"
+        "            self._probe_box[\"r\"] = 1\n"
+        "            self._probe_done.set()\n"
+        "\n"
+        "        threading.Thread(target=probe_fill, daemon=True).start()\n"
+        "        self._probe_done.wait()\n"
+        "        return self._probe_box[\"r\"]\n"
+        "\n"
+    )
+    anchor = "\ndef start_fleet(replicas"
+    src = (REPO_ROOT / rel).read_text()
+    assert anchor in src
+
+    with_handoff = src.replace(anchor, "\n" + probe + anchor, 1)
+    new, _, _ = run_lint([REPO_ROOT / PKG], overlay={rel: with_handoff})
+    assert not any(f.rule.startswith("RACE") for f in new), "\n".join(
+        f.render() for f in new
+    )
+
+    no_wait = with_handoff.replace("        self._probe_done.wait()\n", "", 1)
+    new, _, _ = run_lint([REPO_ROOT / PKG], overlay={rel: no_wait})
+    assert any(
+        f.rule == "RACE001" and "_probe_box" in f.message for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_version_check_moved_outside_lock_is_caught():
+    """Injected race 3: hoist fleet_commit's ``_state_version`` check
+    above the lock — the optimistic-commit recheck is then stale by
+    commit time (RACE003)."""
+    rel = f"{PKG}/runtime/replica.py"
+    anchor = (
+        "        with self._lock:\n"
+        "            if self._state_version != version:\n"
+        "                return None\n"
+    )
+    assert anchor in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(
+            anchor,
+            "        if self._state_version != version:\n"
+            "            return None\n"
+            "        with self._lock:\n",
+            1,
+        ),
+    )
+    assert any(
+        f.rule == "RACE003" and "_state_version" in f.message for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_attr_init_below_thread_start_is_caught():
+    """Injected race 4: move ``heartbeat_interval``'s assignment below
+    the heartbeat thread's start() in TcpTransport.__init__ — the
+    started thread can read the attribute before it exists (RACE004)."""
+    rel = f"{PKG}/runtime/tcp_transport.py"
+    init_line = "        self.heartbeat_interval = heartbeat_interval\n"
+    start_line = "        self._hb_thread.start()\n"
+    src = (REPO_ROOT / rel).read_text()
+    assert init_line in src and start_line in src
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(init_line, "", 1).replace(
+            start_line, start_line + init_line, 1
+        ),
+    )
+    assert any(
+        f.rule == "RACE004" and "heartbeat_interval" in f.message for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_unlocked_dict_iteration_is_caught():
+    """Injected race 5: drop the lock around the heartbeat loop's
+    ``_monitors`` snapshot — monitor()/unregister() mutate the dict
+    from caller threads mid-iteration (RACE005)."""
+    rel = f"{PKG}/runtime/tcp_transport.py"
+    anchor = (
+        "            with self._lock:\n"
+        "                remote_targets = "
+        "[t for t in self._monitors if self._is_remote(t)]\n"
+    )
+    assert anchor in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(
+            anchor,
+            "            remote_targets = "
+            "[t for t in self._monitors if self._is_remote(t)]\n",
+            1,
+        ),
+    )
+    assert any(
+        f.rule == "RACE005" and "_monitors" in f.message for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_unlocked_telemetry_handler_table_is_caught():
+    """Injected race 6 (module globals over the import graph): delete
+    the lock around telemetry.attach's handler-table append — attach
+    runs on caller threads while execute/has_handlers read the table
+    from the replica/fleet event loops (RACE001 on a module global,
+    with the thread root discovered cross-module)."""
+    rel = f"{PKG}/runtime/telemetry.py"
+    anchor = (
+        "def attach(event: tuple, handler: Callable[[tuple, dict, dict], None]) -> None:\n"
+        "    with _lock:\n"
+        "        _handlers[event].append(handler)\n"
+    )
+    assert anchor in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(
+            anchor,
+            "def attach(event: tuple, handler: Callable[[tuple, dict, dict], None]) -> None:\n"
+            "    _handlers[event].append(handler)\n",
+            1,
+        ),
+    )
+    assert any(
+        f.rule == "RACE001" and "_handlers" in f.message for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_race_allow_tag_and_hygiene(tmp_path):
+    """The ``race`` family tag suppresses any RACE00x finding with a
+    stated why; once the race is fixed the leftover allow turns the
+    gate red itself (SUPPRESS001) — same hygiene contract as every
+    other family."""
+    annotated = RACY_COUNTER.replace(
+        "        def size(self):\n",
+        "        def size(self):\n"
+        "            # crdtlint: allow[race] approximate counter: torn\n"
+        "            # reads tolerated, single writer\n",
+    )
+    pkg = make_pkg(tmp_path, {"box.py": annotated})
+    new, _baselined, allowed = run_lint([pkg])
+    assert new == []
+    assert {f.rule for f in allowed} == {"RACE001"}
+
+    # fix the race (single-threaded now) but keep the allow: stale
+    fixed = annotated.replace(
+        "            self._thread = threading.Thread(target=self._loop)\n"
+        "            self._thread.start()\n",
+        "",
+    )
+    pkg2 = make_pkg(tmp_path / "b", {"box.py": fixed})
+    found = lint(pkg2)
+    assert rules_of(found) == {"SUPPRESS001"}
+
+
+def test_race_snapshot_builtin_reports_race005_only(tmp_path):
+    """``list(self._x.values())`` records both an iteration and a
+    method-call access on one line — the defect must surface as ONE
+    RACE005 finding, not a RACE001/RACE005 double report needing two
+    allow comments."""
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "box.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._items = {}
+                    self._thread = threading.Thread(target=self._feed)
+                    self._thread.start()
+
+                def _feed(self):
+                    self._items[1] = 2
+
+                def values(self):
+                    return list(self._items.values())
+            """
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"RACE005"}
+    assert len([f for f in found if "_items" in f.message]) == 1

@@ -1,7 +1,7 @@
 """The crdtlint tier-1 gate.
 
-One test runs the FULL rule suite (all families: LOCK, SYNC, PURE,
-DONATE, WIRE, WAL + the SUPPRESS hygiene pass) over the real package
+One test runs the FULL rule suite (all families: LOCK, RACE, SYNC,
+PURE, DONATE, WIRE, WAL + the SUPPRESS hygiene pass) over the real package
 through the engine and fails on any non-baselined finding — this is the
 regression gate CI leans on, so it renders findings verbatim on
 failure. The rest pin the gate's own wiring: the checked-in protocol
@@ -46,13 +46,41 @@ def test_gate_covers_every_catalogued_family():
     from tools.crdtlint.rules import ALL_RULES
 
     catalogued = {rule for rule, _ in RULE_CATALOG}
-    for family in ("LOCK001", "LOCK002", "LOCK003", "SYNC001", "PURE001",
+    for family in ("LOCK001", "LOCK002", "LOCK003", "RACE001", "RACE002",
+                   "RACE003", "RACE004", "RACE005", "SYNC001", "PURE001",
                    "DONATE001", "WIRE001", "WIRE005", "WAL001", "WAL002",
                    "SUPPRESS001", "SUPPRESS002"):
         assert family in catalogued
     # every registered checker's module exports at least one catalogued
     # rule id (wiring smoke, not a bijection)
-    assert len(ALL_RULES) >= 7
+    assert len(ALL_RULES) >= 8
+
+
+def test_full_suite_wall_clock_budget():
+    """The seven-family suite must stay comfortably inside the tier-1
+    timeout: one full engine run over the real tree in under 60 s (it
+    takes ~2 s today — the budget is headroom, not a target)."""
+    import time
+
+    t0 = time.perf_counter()
+    run_lint([REPO_ROOT / PKG])
+    assert time.perf_counter() - t0 < 60.0
+
+
+def test_jobs_parallel_matches_serial():
+    """--jobs N must be a pure wall-clock lever: findings, their order,
+    and the allow/baseline partition are byte-identical to a serial
+    run (per-rule sharding, merged in registration order)."""
+    serial = run_lint([REPO_ROOT / PKG])
+    parallel = run_lint([REPO_ROOT / PKG], jobs=2)
+    assert serial == parallel
+
+
+def test_stats_reports_per_rule_timing():
+    stats: dict[str, float] = {}
+    run_lint([REPO_ROOT / PKG], stats_out=stats)
+    assert "check_races" in stats and stats["check_races"] > 0
+    assert len(stats) >= 8
 
 
 def test_protocol_manifest_covers_real_package():
@@ -106,6 +134,13 @@ def test_cli_gate_green_and_github_format(tmp_path):
 
 def test_cli_list_rules_names_all_families():
     out = _cli("--list-rules").stdout
-    for rule in ("LOCK002", "LOCK003", "WIRE001", "WIRE004", "WIRE005",
-                 "WAL001", "WAL002", "SUPPRESS001"):
+    for rule in ("LOCK002", "LOCK003", "RACE001", "RACE005", "WIRE001",
+                 "WIRE004", "WIRE005", "WAL001", "WAL002", "SUPPRESS001"):
         assert rule in out
+
+
+def test_cli_jobs_and_stats():
+    proc = _cli(PKG, "--jobs", "2", "--stats")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "timing check_races" in proc.stdout
+    assert "timing total" in proc.stdout

@@ -1,11 +1,19 @@
 """crdtlint — project-specific static analysis for the delta-CRDT runtime.
 
-Four rule families over the package's real import graph (no hardcoded
+Rule families over the package's real import graph (no hardcoded
 file lists):
 
 - ``LOCK001``   lock discipline: accesses to lock-guarded ``self._*``
   attributes on public or thread-entry paths that can run without the
-  guarding lock held;
+  guarding lock held (``LOCK002`` acquisition-order deadlocks,
+  ``LOCK003`` blocking calls under a lock);
+- ``RACE001``–``RACE005`` happens-before races: shared state written on
+  one thread root and accessed on another with no common lock and no
+  HB edge (``Thread.start/join``, ``Event.set/wait``, per-object
+  ``Queue.put/get``), closure escapes across thread boundaries,
+  check-then-act on version fields, publication after
+  ``Thread.start()``, and lock-free iteration of cross-thread-mutated
+  collections;
 - ``SYNC001``/``SYNC002`` JAX host-sync leaks: ``.item()``,
   ``.tolist()``, ``int()``/``float()`` coercion, ``np.asarray`` and
   ``block_until_ready()`` inside functions reachable from a
@@ -19,8 +27,9 @@ file lists):
   arguments re-read after the jitted call.
 
 Suppression: an inline ``# crdtlint: allow[<tag>] <why>`` comment on the
-flagged line (or the line directly above) — tags are ``lock``,
-``host-sync``, ``purity``, ``donation``, an exact rule id, or ``all`` —
+flagged line (or the line directly above) — tags are ``lock``, ``race``,
+``host-sync``, ``purity``, ``donation``, ``wire``, ``wal``, an exact
+rule id, or ``all`` —
 or a checked-in baseline (``--baseline`` / ``--write-baseline``) that
 records pre-existing findings by (path, rule, message) fingerprint.
 """

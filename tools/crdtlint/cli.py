@@ -33,6 +33,19 @@ RULE_CATALOG = [
     ("LOCK003", "blocking call (fsync, socket I/O, sleep, Thread.join, "
                 "Event.wait, block_until_ready, WAL segment roll) reachable "
                 "while a lock is held"),
+    ("RACE001", "shared mutable state (self._* attr or underscore module "
+                "global) written on one thread root and accessed on another "
+                "with no common lock and no happens-before edge"),
+    ("RACE002", "mutable object captured by a thread-entry closure, mutated "
+                "in the thread and used by the enclosing scope after start() "
+                "(or vice versa) without join/handoff"),
+    ("RACE003", "check-then-act on a version field: a lock-guarded monotone "
+                "counter read outside its lock feeds a comparison before the "
+                "lock is taken — stale by commit time"),
+    ("RACE004", "attribute assigned after Thread.start() that the started "
+                "thread reads — the init-race publication window"),
+    ("RACE005", "lock-free iteration of a collection another thread root "
+                "mutates (dict-changed-size / torn traversal)"),
     ("SYNC001", ".item()/.tolist()/int()/float()/np.asarray/device_get/"
                 "block_until_ready inside a function reachable from a "
                 "jax.jit / shard_map / pallas_call entry point"),
@@ -131,6 +144,16 @@ def _main(argv: list[str] | None = None) -> int:
         help="skip the stale-suppression hygiene pass (SUPPRESS001/2)",
     )
     parser.add_argument(
+        "--jobs", type=int, default=1, metavar="N",
+        help="run the rule families in N worker processes (findings and "
+        "their order are identical to a serial run; sharding is per-rule, "
+        "not per-file — most families are whole-project analyses)",
+    )
+    parser.add_argument(
+        "--stats", action="store_true",
+        help="print per-rule wall-clock timing after the report",
+    )
+    parser.add_argument(
         "-q", "--quiet", action="store_true",
         help="suppress the summary line (findings only)",
     )
@@ -176,10 +199,16 @@ def _main(argv: list[str] | None = None) -> int:
     if args.write_protocol_manifest:
         return _write_protocol_manifest(package_dirs, args.manifest)
 
+    if args.jobs < 1:
+        print("crdtlint: --jobs must be >= 1", file=sys.stderr)
+        return 2
+    rule_stats: dict[str, float] = {}
     new, baselined, allowed = run_lint(
         package_dirs, baseline=baseline, select=select,
         manifest=args.manifest,
         hygiene=not (args.no_hygiene or args.write_baseline),
+        jobs=args.jobs,
+        stats_out=rule_stats if args.stats else None,
     )
 
     if args.write_baseline:
@@ -211,6 +240,11 @@ def _main(argv: list[str] | None = None) -> int:
             )
         else:
             print(f.render())
+    if args.stats:
+        total = sum(rule_stats.values())
+        for name, dt in sorted(rule_stats.items(), key=lambda kv: -kv[1]):
+            print(f"crdtlint: timing {name:24s} {dt * 1000:8.1f} ms")
+        print(f"crdtlint: timing {'total':24s} {total * 1000:8.1f} ms")
     if not args.quiet:
         print(
             f"crdtlint: {len(new)} finding(s) "

@@ -23,6 +23,7 @@ _ALLOW_RE = re.compile(r"#\s*crdtlint:\s*allow\[([A-Za-z0-9_\-, ]+)\]")
 #: rule-family tag -> rule-id prefix (an exact rule id or ``all`` also work)
 FAMILY_TAGS = {
     "lock": "LOCK",
+    "race": "RACE",
     "host-sync": "SYNC",
     "purity": "PURE",
     "donation": "DONATE",
@@ -304,6 +305,99 @@ def write_baseline(path: Path, findings: Iterable[Finding]) -> None:
 # ----------------------------------------------------------------------
 # orchestration
 
+def _rules_worker(
+    pkg: str,
+    root: str | None,
+    overlay: dict[str, str] | None,
+    manifest: str | None,
+    rule_names: list[str],
+) -> list[tuple[str, list[Finding], float]]:
+    """Run a chunk of rule families over ONE freshly built project —
+    the unit of ``--jobs`` process parallelism. Per-rule (not per-file)
+    sharding is deliberate: most families are whole-project analyses
+    (the lock-order graph spans classes, wire dispatch spans modules,
+    the thread graph spans the import graph), so a file shard would
+    silently lose every cross-file edge."""
+    import time
+
+    from tools.crdtlint.rules import ALL_RULES
+
+    by_name = {f.__name__: f for f in ALL_RULES}
+    project = Project(
+        Path(pkg),
+        root=Path(root) if root else None,
+        overlay=overlay,
+        manifest=Path(manifest) if manifest else None,
+    )
+    out: list[tuple[str, list[Finding], float]] = []
+    for rule_name in rule_names:
+        t0 = time.perf_counter()
+        found = by_name[rule_name](project)
+        out.append((rule_name, found, time.perf_counter() - t0))
+    return out
+
+
+def _collect_findings(
+    pkg: Path,
+    project: "Project",
+    root: Path | None,
+    overlay: dict[str, str] | None,
+    manifest: Path | None,
+    jobs: int,
+    stats_out: dict[str, float] | None,
+) -> list[Finding]:
+    import time
+
+    from tools.crdtlint.rules import ALL_RULES
+
+    findings: list[Finding] = []
+    if jobs <= 1:
+        for rule_fn in ALL_RULES:
+            t0 = time.perf_counter()
+            findings.extend(rule_fn(project))
+            if stats_out is not None:
+                stats_out[rule_fn.__name__] = (
+                    stats_out.get(rule_fn.__name__, 0.0)
+                    + time.perf_counter() - t0
+                )
+        return findings
+    import concurrent.futures
+    import multiprocessing
+
+    # round-robin the families into one chunk per worker, so every
+    # worker builds the project exactly once
+    names = [fn.__name__ for fn in ALL_RULES]
+    n_chunks = max(1, min(jobs, len(names)))
+    chunks = [names[i::n_chunks] for i in range(n_chunks)]
+    by_rule: dict[str, list[Finding]] = {}
+    # spawn, not fork: the caller may be a test process with live JAX
+    # threads, and forking a multithreaded process can deadlock. The
+    # linter is stdlib-only, so a spawned worker's import cost is small.
+    with concurrent.futures.ProcessPoolExecutor(
+        max_workers=n_chunks,
+        mp_context=multiprocessing.get_context("spawn"),
+    ) as pool:
+        futures = [
+            pool.submit(
+                _rules_worker, str(pkg),
+                str(root) if root else None, overlay,
+                str(manifest) if manifest else None, chunk,
+            )
+            for chunk in chunks
+        ]
+        for fut in futures:
+            for rule_name, out, dt in fut.result():
+                by_rule[rule_name] = out
+                if stats_out is not None:
+                    stats_out[rule_name] = stats_out.get(rule_name, 0.0) + dt
+    # merge in registration order: the list handed to the suppression
+    # pass must be identical to a serial run's, so tie ordering of
+    # same-(path, line, rule) findings cannot drift with scheduling
+    for fn in ALL_RULES:
+        findings.extend(by_rule.get(fn.__name__, []))
+    return findings
+
+
 def run_lint(
     package_dirs: list[Path],
     root: Path | None = None,
@@ -312,6 +406,8 @@ def run_lint(
     select: set[str] | None = None,
     manifest: Path | None = None,
     hygiene: bool = True,
+    jobs: int = 1,
+    stats_out: dict[str, float] | None = None,
 ) -> tuple[list[Finding], list[Finding], list[Finding]]:
     """Lint the given packages.
 
@@ -325,9 +421,11 @@ def run_lint(
     ``allow[...]`` comment no finding used, SUPPRESS002 for a baseline
     entry with leftover count. Neither is itself suppressible — the fix
     is to delete the stale allow/entry (``--write-baseline`` prunes).
-    """
-    from tools.crdtlint.rules import ALL_RULES
 
+    ``jobs > 1`` fans the rule families out to worker processes (each
+    rebuilds the project; findings and their order are identical to a
+    serial run). ``stats_out`` accumulates per-rule wall seconds.
+    """
     new: list[Finding] = []
     baselined: list[Finding] = []
     allowed: list[Finding] = []
@@ -336,9 +434,9 @@ def run_lint(
     for pkg in package_dirs:
         project = Project(Path(pkg), root=root, overlay=overlay,
                           manifest=manifest)
-        findings: list[Finding] = []
-        for rule_fn in ALL_RULES:
-            findings.extend(rule_fn(project))
+        findings = _collect_findings(
+            Path(pkg), project, root, overlay, manifest, jobs, stats_out,
+        )
         by_rel = {m.rel: m for m in project.modules.values()}
         for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
             if select and f.rule not in select:

@@ -76,6 +76,7 @@ def iter_function_defs(tree: ast.AST):
 
 from tools.crdtlint.rules.locks import check_lock_discipline
 from tools.crdtlint.rules.lockorder import check_lock_order
+from tools.crdtlint.rules.races import check_races
 from tools.crdtlint.rules.hostsync import check_host_sync
 from tools.crdtlint.rules.purity import check_purity
 from tools.crdtlint.rules.donation import check_donation
@@ -85,6 +86,7 @@ from tools.crdtlint.rules.walkinds import check_wal_kinds
 ALL_RULES = [
     check_lock_discipline,
     check_lock_order,
+    check_races,
     check_host_sync,
     check_purity,
     check_donation,

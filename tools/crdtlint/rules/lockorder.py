@@ -31,9 +31,9 @@ from __future__ import annotations
 import ast
 
 from tools.crdtlint.engine import Finding, ModuleInfo, Project
-from tools.crdtlint.rules.locks import (
+from tools.crdtlint.rules.threadgraph import (
     INIT,
-    _ClassAnalysis,
+    ClassAnalysis,
     analyse_units,
 )
 
@@ -47,7 +47,7 @@ class _ClassInfo:
     def __init__(self, mod: ModuleInfo, node: ast.ClassDef):
         self.mod = mod
         self.node = node
-        self.cls = _ClassAnalysis(mod, node)
+        self.cls = ClassAnalysis(mod, node)
         self.scans, self.entry_states = analyse_units(self.cls)
         self._reach: dict[str, set[str]] = {}
 

@@ -28,536 +28,37 @@ at the attribute (``self._x.append(...)``, ``self._x.commit()`` — a
 static pass cannot prove a method pure, so calls count as mutation for
 guard inference). Reads count when flagging — a torn read of
 lock-guarded state is as much a race as a write.
+
+The scanning/entry-state machinery this rule pioneered now lives in
+:mod:`tools.crdtlint.rules.threadgraph`, shared with LOCK002/003 and
+the RACE happens-before family.
 """
 
 from __future__ import annotations
 
 import ast
-import dataclasses
 from typing import Iterator
 
 from tools.crdtlint.engine import Finding, ModuleInfo, Project
-from tools.crdtlint.rules import THREADSAFE_CONSTRUCTORS, self_attr
+from tools.crdtlint.rules.threadgraph import (
+    INIT,
+    ClassAnalysis,
+    analyse_units,
+    infer_guards,
+)
 
 RULE = "LOCK001"
 
-#: pseudo lock-state token for __init__-reachable code (pre-publication:
-#: single-threaded by construction, so neither flagged nor guard-minting)
-INIT = "<init>"
-
-
-@dataclasses.dataclass(frozen=True)
-class Access:
-    method: str
-    line: int
-    attr: str
-    kind: str  # "read" | "write" | "call"
-    held: frozenset  # lock attrs held lexically at this point
-
-
-@dataclasses.dataclass(frozen=True)
-class CallEdge:
-    callee: str  # method name on self
-    held: frozenset
-
-
-@dataclasses.dataclass(frozen=True)
-class AcquireEvent:
-    """One lock-acquisition site (``with self._x:`` or ``.acquire()``)
-    with the lexical lock state just BEFORE it — the raw material of the
-    LOCK002 acquisition-order graph."""
-
-    method: str
-    line: int
-    lock: str
-    held_before: frozenset
-
-
-@dataclasses.dataclass(frozen=True)
-class BlockingEvent:
-    """A call that can block the thread (fsync, socket I/O, sleep,
-    thread join, device sync…) and the lexical lock state at the call —
-    LOCK003 flags those reachable with any lock held."""
-
-    method: str
-    line: int
-    what: str
-    held: frozenset
-
-
-@dataclasses.dataclass(frozen=True)
-class AttrCall:
-    """``self.X.m(...)`` — a method call on a member object. When X's
-    class is statically known (constructed in this class), LOCK002/003
-    follow the edge into that class's methods."""
-
-    method: str
-    line: int
-    attr: str
-    callee: str
-    held: frozenset
-
-
-#: call leaves that block the calling thread regardless of receiver
-BLOCKING_LEAVES = {
-    "fsync": "os.fsync",
-    "sendall": "socket sendall",
-    "recv": "socket recv",
-    "accept": "socket accept",
-    "connect": "socket connect",
-    "create_connection": "socket connect",
-    "getaddrinfo": "DNS resolution",
-    "sleep": "time.sleep",
-    "block_until_ready": "device sync (block_until_ready)",
-    "fsync_dir": "os.fsync (directory)",
-}
-
-#: leaves that block only for specific receiver types — counted when the
-#: receiver is a ``self.`` attribute constructed as one of these
-BLOCKING_RECEIVER_LEAVES = {
-    "join": ("Thread",),
-    "wait": ("Event", "Condition", "Barrier"),
-}
-
-
-def _dotted_chain(node: ast.AST) -> str | None:
-    """``a.b.C`` attribute chain -> "a.b.C" (None when not a plain chain)."""
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-def _call_receiver_attr(func: ast.AST) -> str | None:
-    """Root ``self._x`` of a call-receiver chain: ``self._x.m(...)``,
-    ``self._x[k].m(...)``, ``self._x.a.m(...)`` all root at ``_x``."""
-    if not isinstance(func, ast.Attribute):
-        return None
-    node = func.value
-    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
-        if isinstance(node, ast.Attribute):
-            found = self_attr(node)
-            if found is not None:
-                return found
-            node = node.value
-        elif isinstance(node, ast.Subscript):
-            node = node.value
-        else:
-            node = node.func
-    return self_attr(node) if isinstance(node, ast.Attribute) else None
-
-
-class _MethodScan(ast.NodeVisitor):
-    """One pass over a method body collecting attribute accesses, call
-    edges to other ``self.`` methods, and the lexical lock state.
-
-    Lock state tracking is statement-ordered: a ``with self._lock:``
-    holds inside its body; ``self._lock.acquire(...)`` (or a call to an
-    acquire-wrapper method) holds until ``self._lock.release()`` in the
-    same or an outer suite. Nested function defs are analysed inline at
-    their definition point (closures run with whatever lock state their
-    caller establishes — conservative for callbacks, exact for the
-    immediately-called lambda idiom), except thread-entry defs, which
-    the class analysis lifts into separate lock-free entry points.
-    """
-
-    def __init__(self, cls: "_ClassAnalysis", method: str, skip_defs: set[ast.AST]):
-        self.cls = cls
-        self.method = method
-        self.skip_defs = skip_defs
-        self.held: set[str] = set()
-        self.accesses: list[Access] = []
-        self.edges: list[CallEdge] = []
-        self.acquires: list[AcquireEvent] = []
-        self.blocking: list[BlockingEvent] = []
-        self.attr_calls: list[AttrCall] = []
-
-    # -- lock state ----------------------------------------------------
-
-    def _is_lock_attr(self, node: ast.AST) -> str | None:
-        attr = self_attr(node)
-        return attr if attr in self.cls.lock_attrs else None
-
-    def visit_With(self, node: ast.With) -> None:
-        entered: list[str] = []
-        for item in node.items:
-            lock = self._is_lock_attr(item.context_expr)
-            if lock is not None:
-                self.acquires.append(AcquireEvent(
-                    self.method, item.context_expr.lineno, lock,
-                    frozenset(self.held),
-                ))
-                # only locks not already held: a nested reentrant
-                # ``with self._lock:`` (RLock) must not release the
-                # outer hold when the inner block exits
-                if lock not in self.held:
-                    entered.append(lock)
-            else:
-                self.visit(item.context_expr)
-            if item.optional_vars is not None:
-                self.visit(item.optional_vars)
-        self.held.update(entered)
-        for stmt in node.body:
-            self.visit(stmt)
-        for lock in entered:
-            self.held.discard(lock)
-
-    visit_AsyncWith = visit_With
-
-    @staticmethod
-    def _terminates(stmts: list[ast.stmt]) -> bool:
-        return bool(stmts) and isinstance(
-            stmts[-1], (ast.Raise, ast.Return, ast.Continue, ast.Break)
-        )
-
-    def visit_If(self, node: ast.If) -> None:
-        # branch-merge: a lock acquired in only one branch is not held
-        # after the join (the acquire-then-raise guard idiom keeps its
-        # lock because the acquiring branch is the TEST, visited first,
-        # and a terminating branch contributes nothing to the join)
-        self.visit(node.test)
-        pre = set(self.held)
-        self.held = set(pre)
-        for s in node.body:
-            self.visit(s)
-        body_held = self.held
-        self.held = set(pre)
-        for s in node.orelse:
-            self.visit(s)
-        else_held = self.held
-        if self._terminates(node.body):
-            self.held = else_held
-        elif node.orelse and self._terminates(node.orelse):
-            self.held = body_held
-        else:
-            self.held = body_held & else_held
-
-    def _visit_loop(self, node) -> None:
-        # a loop body may run zero times: locks acquired (or released)
-        # inside don't survive the loop — intersect with the pre-state
-        pre = set(self.held)
-        for child in ast.iter_child_nodes(node):
-            self.visit(child)
-        self.held &= pre
-
-    visit_For = _visit_loop
-    visit_AsyncFor = _visit_loop
-    visit_While = _visit_loop
-
-    def _note_blocking(self, func: ast.Attribute | ast.Name, line: int) -> None:
-        leaf = func.attr if isinstance(func, ast.Attribute) else func.id
-        what = BLOCKING_LEAVES.get(leaf)
-        if what is None and isinstance(func, ast.Attribute):
-            # receiver-typed blockers: thread join, event/condition wait
-            ctors = BLOCKING_RECEIVER_LEAVES.get(leaf)
-            if ctors:
-                recv = self_attr(func.value)
-                chain = self.cls.attr_ctors.get(recv) if recv is not None else None
-                ctor = chain.rsplit(".", 1)[-1] if chain else None
-                if ctor in ctors:
-                    what = f"{ctor}.{leaf}"
-        if what is not None:
-            self.blocking.append(
-                BlockingEvent(self.method, line, what, frozenset(self.held))
-            )
-
-    def visit_Call(self, node: ast.Call) -> None:
-        func = node.func
-        if isinstance(func, ast.Attribute):
-            lock = self._is_lock_attr(func.value)
-            if lock is not None:
-                if func.attr == "acquire":
-                    self.acquires.append(AcquireEvent(
-                        self.method, node.lineno, lock, frozenset(self.held)
-                    ))
-                    self.held.add(lock)
-                elif func.attr == "release":
-                    self.held.discard(lock)
-                for arg in node.args + [kw.value for kw in node.keywords]:
-                    self.visit(arg)
-                return
-            callee = self_attr(func)
-            if callee is not None and callee in self.cls.methods:
-                # self.helper(...): record the call edge; an acquire-
-                # wrapper helper (net-acquires, e.g. Replica._acquire)
-                # flips our lexical state exactly like a raw acquire()
-                self.edges.append(CallEdge(callee, frozenset(self.held)))
-                for arg in node.args + [kw.value for kw in node.keywords]:
-                    self.visit(arg)
-                self.held.update(self.cls.acquire_wrappers.get(callee, set()))
-                return
-            self._note_blocking(func, node.lineno)
-            recv = _call_receiver_attr(func)
-            if recv is not None:
-                # method call rooted at a self attribute: potential
-                # in-place mutation of that attribute's object
-                self._record(recv, func.lineno, "call")
-                direct = self_attr(func.value)
-                if direct is not None:
-                    self.attr_calls.append(AttrCall(
-                        self.method, node.lineno, direct, func.attr,
-                        frozenset(self.held),
-                    ))
-                self.visit(func.value)
-                for arg in node.args + [kw.value for kw in node.keywords]:
-                    self.visit(arg)
-                return
-        elif isinstance(func, ast.Name):
-            self._note_blocking(func, node.lineno)
-        self.generic_visit(node)
-
-    # -- accesses ------------------------------------------------------
-
-    def _record(self, attr: str, line: int, kind: str) -> None:
-        if attr in self.cls.exempt_attrs or not attr.startswith("_"):
-            return
-        if attr in self.cls.methods or attr in self.cls.thread_entries:
-            return  # bound-method reference, not state
-        self.accesses.append(
-            Access(self.method, line, attr, kind, frozenset(self.held))
-        )
-
-    def visit_Attribute(self, node: ast.Attribute) -> None:
-        attr = self_attr(node)
-        if attr is not None:
-            kind = "write" if isinstance(node.ctx, (ast.Store, ast.Del)) else "read"
-            self._record(attr, node.lineno, kind)
-        self.generic_visit(node)
-
-    def visit_Subscript(self, node: ast.Subscript) -> None:
-        # self._x[k] = v / del self._x[k]: the Attribute itself is Load,
-        # but the container is mutated — count a write
-        if isinstance(node.ctx, (ast.Store, ast.Del)):
-            attr = self_attr(node.value)
-            if attr is not None:
-                self._record(attr, node.lineno, "write")
-                self.visit(node.slice)
-                return
-        self.generic_visit(node)
-
-    def visit_AugAssign(self, node: ast.AugAssign) -> None:
-        attr = self_attr(node.target)
-        if attr is not None:
-            self._record(attr, node.lineno, "write")
-            self.visit(node.value)
-            return
-        self.generic_visit(node)
-
-    # -- nested defs ---------------------------------------------------
-
-    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
-        if node in self.skip_defs:
-            return  # analysed separately as a thread entry
-        for stmt in node.body:  # inline: closures see the caller's locks
-            self.visit(stmt)
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-    def visit_Lambda(self, node: ast.Lambda) -> None:
-        self.visit(node.body)
-
-
-class _ClassAnalysis:
-    def __init__(self, mod: ModuleInfo, node: ast.ClassDef):
-        self.mod = mod
-        self.node = node
-        self.name = node.name
-        # keyed by a UNIQUE unit name: a class may define several defs
-        # under one name (property getter + setter/deleter overloads) —
-        # a plain name-keyed dict would shadow all but the last, leaving
-        # e.g. a property getter's lock region entirely unanalysed
-        self.methods: dict[str, ast.FunctionDef] = {}
-        for n in node.body:
-            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            name = n.name
-            k = 2
-            while name in self.methods:
-                name = f"{n.name}#{k}"  # "#k" never collides with real names
-                k += 1
-            self.methods[name] = n
-        self.lock_attrs = self._find_constructed(("Lock", "RLock"))
-        self.exempt_attrs = self._find_constructed(tuple(THREADSAFE_CONSTRUCTORS))
-        self.exempt_attrs |= self.lock_attrs
-        #: attr -> constructor leaf name for attrs assigned a direct
-        #: ``self.x = Ctor(...)`` (receiver-typed blocking + the
-        #: cross-class edges of the LOCK002/003 order analysis)
-        self.attr_ctors: dict[str, str] = self._find_attr_ctors()
-        # thread-entry units: entry name -> FunctionDef (bound methods
-        # and nested defs passed as Thread(target=...))
-        self.thread_entries: dict[str, ast.FunctionDef] = {}
-        self.nested_entry_defs: set[ast.AST] = set()
-        self._find_thread_entries()
-        # methods that net-acquire a lock for their caller
-        self.acquire_wrappers: dict[str, set[str]] = self._find_acquire_wrappers()
-
-    def _find_constructed(self, ctor_names: tuple[str, ...]) -> set[str]:
-        out: set[str] = set()
-        for body_fn in self.methods.values():
-            for stmt in ast.walk(body_fn):
-                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
-                    continue
-                targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
-                value = stmt.value
-                if not isinstance(value, ast.Call):
-                    continue
-                leaf = (
-                    value.func.attr
-                    if isinstance(value.func, ast.Attribute)
-                    else value.func.id if isinstance(value.func, ast.Name) else None
-                )
-                if leaf not in ctor_names:
-                    continue
-                for t in targets:
-                    attr = self_attr(t)
-                    if attr is not None:
-                        out.add(attr)
-        return out
-
-    def _find_attr_ctors(self) -> dict[str, str]:
-        """attr -> constructor dotted chain (``WalLog`` / ``wal.WalLog``
-        / ``threading.Thread``) for direct ``self.x = Ctor(...)``
-        assignments. Consumers compare the LEAF for receiver typing and
-        resolve the full chain for cross-class edges."""
-        out: dict[str, str] = {}
-        for body_fn in self.methods.values():
-            for stmt in ast.walk(body_fn):
-                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
-                    continue
-                targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
-                value = stmt.value
-                if not isinstance(value, ast.Call):
-                    continue
-                chain = (
-                    value.func.id
-                    if isinstance(value.func, ast.Name)
-                    else _dotted_chain(value.func)
-                )
-                if chain is None:
-                    continue
-                for t in targets:
-                    attr = self_attr(t)
-                    if attr is not None:
-                        out[attr] = chain
-        return out
-
-    def _find_thread_entries(self) -> None:
-        for mname, body_fn in self.methods.items():
-            nested = {
-                n.name: n
-                for n in ast.walk(body_fn)
-                if isinstance(n, ast.FunctionDef) and n is not body_fn
-            }
-            for call in ast.walk(body_fn):
-                if not isinstance(call, ast.Call):
-                    continue
-                leaf = (
-                    call.func.attr
-                    if isinstance(call.func, ast.Attribute)
-                    else call.func.id if isinstance(call.func, ast.Name) else None
-                )
-                if leaf != "Thread":
-                    continue
-                for kw in call.keywords:
-                    if kw.arg != "target":
-                        continue
-                    tgt_attr = self_attr(kw.value)
-                    if tgt_attr is not None and tgt_attr in self.methods:
-                        self.thread_entries[tgt_attr] = self.methods[tgt_attr]
-                    elif isinstance(kw.value, ast.Name) and kw.value.id in nested:
-                        entry = nested[kw.value.id]
-                        self.thread_entries[f"{mname}.<{entry.name}>"] = entry
-                        self.nested_entry_defs.add(entry)
-
-    def _find_acquire_wrappers(self) -> dict[str, set[str]]:
-        out: dict[str, set[str]] = {}
-        for mname, body_fn in self.methods.items():
-            acquired: set[str] = set()
-            released: set[str] = set()
-            for call in ast.walk(body_fn):
-                if not isinstance(call, ast.Call) or not isinstance(
-                    call.func, ast.Attribute
-                ):
-                    continue
-                lock = self_attr(call.func.value)
-                if lock in self.lock_attrs:
-                    if call.func.attr == "acquire":
-                        acquired.add(lock)
-                    elif call.func.attr == "release":
-                        released.add(lock)
-            net = acquired - released
-            if net:
-                out[mname] = net
-        return out
-
-
-def _scan_unit(cls: _ClassAnalysis, unit_name: str, fn: ast.FunctionDef) -> _MethodScan:
-    scan = _MethodScan(cls, unit_name, cls.nested_entry_defs)
-    for stmt in fn.body:
-        scan.visit(stmt)
-    return scan
-
-
-def analyse_units(
-    cls: _ClassAnalysis,
-) -> tuple[dict[str, "_MethodScan"], dict[str, set[frozenset]]]:
-    """Scan every unit (method or thread entry) of one class and
-    propagate entry lock states interprocedurally: public methods and
-    thread entries start lock-free, ``__init__`` gets the INIT
-    pseudo-state (pre-publication), and each call edge forwards
-    caller-entry ∪ call-site lexical locks to the callee. Shared by
-    LOCK001 (guard inference) and LOCK002/003 (order/blocking)."""
-    units: dict[str, ast.FunctionDef] = dict(cls.methods)
-    units.update(cls.thread_entries)
-    scans = {name: _scan_unit(cls, name, fn) for name, fn in units.items()}
-
-    entry_states: dict[str, set[frozenset]] = {name: set() for name in units}
-    for name in units:
-        if name in cls.thread_entries or not name.startswith("_"):
-            entry_states[name].add(frozenset())
-    if "__init__" in entry_states:
-        entry_states["__init__"] = {frozenset({INIT})}
-
-    # propagate: caller entry-state ∪ call-site lexical locks -> callee
-    changed = True
-    guard = 0
-    while changed and guard < 10_000:
-        changed = False
-        guard += 1
-        for name, scan in scans.items():
-            for entry in list(entry_states[name]):
-                for edge in scan.edges:
-                    if edge.callee not in entry_states:
-                        continue
-                    state = frozenset(entry | edge.held)
-                    if state not in entry_states[edge.callee]:
-                        entry_states[edge.callee].add(state)
-                        changed = True
-    return scans, entry_states
-
 
 def _analyse_class(mod: ModuleInfo, node: ast.ClassDef) -> Iterator[Finding]:
-    cls = _ClassAnalysis(mod, node)
+    cls = ClassAnalysis(mod, node)
     if not cls.lock_attrs:
         return
 
     scans, entry_states = analyse_units(cls)
 
     # guarded attributes: attr -> set of locks it was written under
-    guards: dict[str, set[str]] = {}
-    for name, scan in scans.items():
-        for entry in entry_states[name]:
-            if INIT in entry:
-                continue
-            for acc in scan.accesses:
-                if acc.kind in ("write", "call"):
-                    held = entry | acc.held
-                    if held:
-                        guards.setdefault(acc.attr, set()).update(held)
+    guards = infer_guards(scans, entry_states)
 
     # flag accesses reachable with none of the attribute's guards held
     seen: set[tuple[int, str]] = set()

@@ -1,0 +1,490 @@
+"""RACE001–005 — happens-before race detection across the thread graph.
+
+The LOCK family checks lock *discipline* (what a lock guards, the
+acquisition order, blocking under a lock). It is blind to the actual
+race condition: two thread roots touching the same mutable state with
+no common lock and no happens-before edge — the bug class that breaks
+convergence silently (a torn merge is not a join). These rules consume
+the shared thread-graph/happens-before engine
+(:mod:`tools.crdtlint.rules.threadgraph`), which discovers every thread
+entry root over the real import graph (``Thread(target=...)`` bound
+methods and nested loop defs — the fleet tick loop, the replica event
+loop, the TCP accept/heartbeat/serve/HELLO-wait threads — plus module
+functions reached from those roots cross-module) and the HB edges
+``Thread.start/join``, ``Event.set/wait``, per-object ``Queue.put/get``.
+
+- **RACE001** — shared mutable state (``self._*`` attribute, or an
+  underscore module global) written on one thread root and accessed on
+  another with no common lock and no happens-before path. LOCK001
+  cannot see these: an attribute never written under any lock mints no
+  guard, so a completely lock-free cross-thread counter passes the
+  discipline check while every read of it is torn.
+- **RACE002** — a mutable object captured by a thread-entry closure,
+  mutated inside the thread and accessed by the enclosing scope after
+  ``start()`` (or vice versa). A ``join()`` before the enclosing access
+  orders it; a threadsafe capture (``Queue``/``Event``) is exempt.
+- **RACE003** — check-then-act on a version field: a read of a
+  lock-guarded monotone counter (``_state_version`` shape: ``+= 1``
+  under a lock) feeding a comparison OUTSIDE that lock in a unit that
+  later takes the lock. The check's answer is stale by the time the
+  act runs; the optimistic-commit pattern requires the re-check itself
+  to sit inside the lock (``Replica.fleet_commit`` is the model).
+- **RACE004** — an attribute published after ``Thread.start()`` that
+  the started thread reads: the init-race window where the thread can
+  observe the pre-assignment value (or an ``AttributeError``). Writes
+  sequenced before ``start()`` are the blessed publication idiom.
+- **RACE005** — lock-free iteration (``for``/comprehension/snapshot
+  builtins like ``list(...)``/``sorted(...)``) of a collection another
+  root mutates: ``RuntimeError: dictionary changed size`` at best, a
+  silently-skipped element at worst.
+
+Write notion (deliberately narrower than LOCK001's guard inference):
+stores, deletes, augmented/item assignment, and calls of known mutator
+methods — an unknown method call is NOT assumed mutating, or every
+cross-thread socket/file shutdown idiom would flood the report.
+Known boundary (documented): cross-class method calls through member
+references (``rep.fleet_commit(...)`` from the fleet loop) are analysed
+in the callee class's own context, where the external-caller root
+already models "some other thread".
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+
+from tools.crdtlint.engine import Finding, Project
+from tools.crdtlint.rules import MUTATOR_METHODS, THREADSAFE_CONSTRUCTORS, self_attr
+from tools.crdtlint.rules.threadgraph import (
+    Access,
+    ConcurrencyModel,
+    build_models,
+    infer_guards,
+    is_race_write,
+    pair_unordered,
+)
+
+RULE_SHARED = "RACE001"
+RULE_ESCAPE = "RACE002"
+RULE_CHECK_ACT = "RACE003"
+RULE_PUBLISH = "RACE004"
+RULE_ITER = "RACE005"
+
+_BUILTIN_NAMES = frozenset(dir(builtins))
+
+def _verb(acc: Access) -> str:
+    if acc.kind == "call":
+        return ("mutating call" if acc.leaf in MUTATOR_METHODS
+                else "method call")
+    return {"read": "read", "write": "write", "iter": "iteration"}[acc.kind]
+
+
+def _unit_fns(model: ConcurrencyModel) -> dict[str, ast.FunctionDef]:
+    fns = dict(model.owner.methods)
+    fns.update(model.owner.thread_entries)
+    return fns
+
+
+def _unit_label(model: ConcurrencyModel, unit: str) -> str:
+    if model.owner.is_module:
+        return f"{model.mod.name.rsplit('.', 1)[-1]}.{unit}"
+    return f"{model.owner.name}.{unit}"
+
+
+def _guards(model: ConcurrencyModel) -> dict[str, set[str]]:
+    return infer_guards(model.scans, model.entry_states)
+
+
+# ----------------------------------------------------------------------
+# RACE001 — cross-root shared state, no lock, no happens-before
+
+def _race001(model: ConcurrencyModel) -> list[Finding]:
+    findings: list[Finding] = []
+    for attr in sorted(model.tracked_attrs()):
+        sites = list(model.accesses_of(attr))
+        if not sites:
+            continue
+        iter_lines = {
+            (unit, acc.line)
+            for unit, acc, _roots, _locks in model.accesses_of(attr, include_iters=True)
+            if acc.kind == "iter"
+        }
+        writes = [s for s in sites if is_race_write(s[1])]
+        if not writes:
+            continue
+        flagged: set[tuple[str, int]] = set()
+        for au, a, ar, al in sites:
+            if not is_race_write(a) and (au, a.line) in iter_lines:
+                # RACE005 owns iteration sites: a snapshot builtin like
+                # list(self._x.values()) records both a plain read AND
+                # a non-mutating 'call' access on the same line — skip
+                # every non-write shape, or one defect reports twice
+                continue
+            counterpart = None
+            for wu, w, wr, wl in writes:
+                if wl & al:
+                    continue  # common lock on every path
+                pair = pair_unordered(model, wu, w, wr, au, a, ar)
+                if pair is None:
+                    continue
+                cand = (wu, w.line, pair[0], pair[1])
+                if counterpart is None or cand < counterpart:
+                    counterpart = cand
+            if counterpart is None:
+                continue
+            key = (au, a.line)
+            if key in flagged:
+                continue
+            flagged.add(key)
+            wu, _wline, root_w, root_a = counterpart
+            label = model.attr_label(attr)
+            findings.append(Finding(
+                model.mod.rel, a.line, RULE_SHARED,
+                f"cross-thread race on {label}: {_verb(a)} in "
+                f"{_unit_label(model, au)} (thread root {root_a}) is "
+                f"concurrent with the write in {_unit_label(model, wu)} "
+                f"(root {root_w}) — no common lock, no happens-before edge",
+            ))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# RACE002 — mutable closure capture across a thread boundary
+
+def _free_names(fn: ast.FunctionDef) -> set[str]:
+    bound = {fn.name}
+    args = fn.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs
+              + ([args.vararg] if args.vararg else [])
+              + ([args.kwarg] if args.kwarg else [])):
+        bound.add(a.arg)
+    used: set[str] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Name):
+            if isinstance(n.ctx, (ast.Store, ast.Del)):
+                bound.add(n.id)
+            else:
+                used.add(n.id)
+        elif isinstance(n, ast.arg):
+            bound.add(n.arg)
+    return used - bound - _BUILTIN_NAMES - {"self"}
+
+
+def _mutation_lines(fn: ast.AST, name: str,
+                    after: int = 0, exclude: "tuple[int, int] | None" = None
+                    ) -> list[int]:
+    """Lines where the object bound to ``name`` is mutated in place."""
+
+    def rooted(node: ast.AST) -> bool:
+        while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+            node = (node.func if isinstance(node, ast.Call)
+                    else node.value)
+        return isinstance(node, ast.Name) and node.id == name
+
+    out: list[int] = []
+    for n in ast.walk(fn):
+        line = getattr(n, "lineno", None)
+        if line is None or line <= after:
+            continue
+        if exclude and exclude[0] <= line <= exclude[1]:
+            continue
+        if isinstance(n, (ast.Attribute, ast.Subscript)):
+            if isinstance(n.ctx, (ast.Store, ast.Del)) and rooted(n.value):
+                out.append(line)
+        elif isinstance(n, ast.AugAssign):
+            if isinstance(n.target, (ast.Attribute, ast.Subscript)) and rooted(
+                n.target.value
+            ):
+                out.append(line)
+        elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            if n.func.attr in MUTATOR_METHODS and rooted(n.func.value):
+                out.append(line)
+    return sorted(out)
+
+
+def _access_lines(fn: ast.AST, name: str,
+                  after: int = 0, exclude: "tuple[int, int] | None" = None
+                  ) -> list[int]:
+    out = []
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Name) and n.id == name:
+            line = n.lineno
+            if line <= after:
+                continue
+            if exclude and exclude[0] <= line <= exclude[1]:
+                continue
+            out.append(line)
+    return sorted(out)
+
+
+def _race002(model: ConcurrencyModel) -> list[Finding]:
+    findings: list[Finding] = []
+    fns = _unit_fns(model)
+    for entry_name, entry_fn in sorted(model.owner.thread_entries.items()):
+        if ".<" not in entry_name:
+            continue  # bound-method entries capture self, handled per class
+        encl_name = entry_name.split(".<", 1)[0]
+        encl_fn = fns.get(encl_name)
+        scan = model.scans.get(encl_name)
+        if encl_fn is None or scan is None:
+            continue
+        starts = [s for s in scan.syncs if s.op == "start" and s.obj == entry_name]
+        if not starts:
+            continue
+        start_line = min(s.line for s in starts)
+        joins = [s.line for s in scan.syncs
+                 if s.op == "join" and s.obj == entry_name]
+        join_line = min(joins) if joins else None
+        span = (entry_fn.lineno, entry_fn.end_lineno or entry_fn.lineno)
+        # names the enclosing scope bound to threadsafe objects are the
+        # blessed cross-thread channels, not escapes
+        safe: set[str] = set()
+        for n in ast.walk(encl_fn):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                leaf = (n.value.func.attr if isinstance(n.value.func, ast.Attribute)
+                        else n.value.func.id if isinstance(n.value.func, ast.Name)
+                        else None)
+                if leaf in THREADSAFE_CONSTRUCTORS:
+                    safe.update(t.id for t in n.targets if isinstance(t, ast.Name))
+        for name in sorted(_free_names(entry_fn) - safe
+                           - set(model.owner.methods)
+                           - getattr(model.owner, "trackable", set())):
+            entry_muts = _mutation_lines(entry_fn, name)
+            entry_uses = _access_lines(entry_fn, name)
+
+            def _unjoined(lines: list[int]) -> list[int]:
+                return [l for l in lines if join_line is None or l < join_line]
+
+            encl_uses_after = _unjoined(
+                _access_lines(encl_fn, name, after=start_line, exclude=span))
+            encl_muts_after = _unjoined(
+                _mutation_lines(encl_fn, name, after=start_line, exclude=span))
+            if entry_muts and encl_uses_after:
+                findings.append(Finding(
+                    model.mod.rel, encl_uses_after[0], RULE_ESCAPE,
+                    f"mutable {name!r} escapes into thread entry "
+                    f"{_unit_label(model, entry_name)}: the thread mutates it "
+                    f"and {_unit_label(model, encl_name)} still uses it after "
+                    f"start() — join first, or hand it over via a "
+                    f"Queue/Event",
+                ))
+            elif encl_muts_after and entry_uses:
+                findings.append(Finding(
+                    model.mod.rel, encl_muts_after[0], RULE_ESCAPE,
+                    f"mutable {name!r} escapes into thread entry "
+                    f"{_unit_label(model, entry_name)}: "
+                    f"{_unit_label(model, encl_name)} mutates it after "
+                    f"start() while the thread reads it — mutate before "
+                    f"start(), or synchronize the handoff",
+                ))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# RACE003 — check-then-act on version fields
+
+def _race003(model: ConcurrencyModel) -> list[Finding]:
+    guards = _guards(model)
+    aug_attrs = {
+        acc.attr
+        for scan in model.scans.values()
+        for acc in scan.accesses
+        if acc.aug
+    }
+    version_fields = {a for a in aug_attrs if guards.get(a)}
+    if not version_fields:
+        return []
+    findings: list[Finding] = []
+    fns = _unit_fns(model)
+    for unit in sorted(model.scans):
+        scan = model.scans[unit]
+        fn = fns.get(unit)
+        if fn is None or not model.roots.get(unit):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Compare):
+                continue
+            for sub in ast.walk(node):
+                attr = (sub.id if model.owner.is_module and isinstance(sub, ast.Name)
+                        else self_attr(sub))
+                if attr not in version_fields:
+                    continue
+                acc = next(
+                    (a for a in scan.accesses
+                     if a.attr == attr and a.line == sub.lineno
+                     and a.kind == "read"),
+                    None,
+                )
+                if acc is None:
+                    continue
+                locks = model.effective_locks(unit, acc)
+                if locks is None or locks & guards[attr]:
+                    continue
+                later_acquire = any(
+                    aq.lock in guards[attr] and aq.line > node.lineno
+                    for aq in scan.acquires
+                )
+                if not later_acquire:
+                    continue
+                lock_name = "/".join(
+                    sorted(model.attr_label(l) for l in guards[attr]))
+                findings.append(Finding(
+                    model.mod.rel, node.lineno, RULE_CHECK_ACT,
+                    f"check-then-act on {model.attr_label(attr)} in "
+                    f"{_unit_label(model, unit)}: the version check runs "
+                    f"outside {lock_name} (which guards its writes) and the "
+                    f"lock is only taken afterwards — the check is stale by "
+                    f"commit time; move it inside the locked region",
+                ))
+    # one finding per compare site
+    seen: set[tuple[int, str]] = set()
+    out = []
+    for f in findings:
+        if (f.line, f.message) not in seen:
+            seen.add((f.line, f.message))
+            out.append(f)
+    return out
+
+
+# ----------------------------------------------------------------------
+# RACE004 — publication after Thread.start
+
+def _race004(model: ConcurrencyModel) -> list[Finding]:
+    if model.owner.is_module:
+        return []  # module globals published post-start fall to RACE001
+    findings: list[Finding] = []
+    fns = _unit_fns(model)
+    seen: set[tuple[int, str]] = set()
+    for unit in sorted(model.scans):
+        scan = model.scans[unit]
+        fn = fns.get(unit)
+        if fn is None:
+            continue
+        starts = [s for s in scan.syncs if s.op == "start"]
+        if not starts:
+            continue
+        # writes to self.X (ANY name — public config attrs are exactly
+        # the init-race candidates) after the earliest start of each root
+        for s in starts:
+            root = s.obj
+            reader_units = [
+                u for u, roots in model.roots.items() if root in roots
+            ]
+            if not reader_units:
+                continue
+            entry_def = model.owner.thread_entries.get(root)
+            span = (
+                (entry_def.lineno, entry_def.end_lineno or entry_def.lineno)
+                if entry_def is not None and ".<" in root else None
+            )
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                    continue
+                line = node.lineno
+                if line <= s.line:
+                    continue
+                if span and span[0] <= line <= span[1]:
+                    continue
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    attr = self_attr(t)
+                    if attr is None or attr in model.owner.exempt_attrs:
+                        continue
+                    read_in = next(
+                        (u for u in sorted(reader_units)
+                         if _reads_self_attr(fns.get(u), attr)),
+                        None,
+                    )
+                    if read_in is None:
+                        continue
+                    key = (line, attr)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    findings.append(Finding(
+                        model.mod.rel, line, RULE_PUBLISH,
+                        f"self.{attr} is assigned after Thread.start() of "
+                        f"{root} in {_unit_label(model, unit)}, but the "
+                        f"started thread reads it "
+                        f"({_unit_label(model, read_in)}) — the thread can "
+                        f"observe the pre-assignment value; initialise "
+                        f"before start()",
+                    ))
+    return findings
+
+
+def _reads_self_attr(fn: "ast.FunctionDef | None", attr: str) -> bool:
+    if fn is None:
+        return False
+    for n in ast.walk(fn):
+        if (
+            isinstance(n, ast.Attribute)
+            and isinstance(n.ctx, ast.Load)
+            and self_attr(n) == attr
+        ):
+            return True
+    return False
+
+
+# ----------------------------------------------------------------------
+# RACE005 — lock-free iteration of a cross-thread-mutated collection
+
+def _race005(model: ConcurrencyModel) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[tuple[int, str]] = set()
+    for attr in sorted(model.tracked_attrs()):
+        all_sites = list(model.accesses_of(attr, include_iters=True))
+        iters = [s for s in all_sites if s[1].kind == "iter"]
+        if not iters:
+            continue
+        writes = [s for s in all_sites if is_race_write(s[1])]
+        if not writes:
+            continue
+        for iu, i, ir, il in iters:
+            counterpart = None
+            for wu, w, wr, wl in writes:
+                if wu == iu and w.line == i.line:
+                    continue  # the loop's own body mutating as it goes
+                if wl & il:
+                    continue
+                pair = pair_unordered(model, wu, w, wr, iu, i, ir)
+                if pair is None:
+                    continue
+                cand = (wu, w.line, pair[0], pair[1])
+                if counterpart is None or cand < counterpart:
+                    counterpart = cand
+            if counterpart is None:
+                continue
+            key = (i.line, attr)
+            if key in seen:
+                continue
+            seen.add(key)
+            wu, _wline, root_w, root_i = counterpart
+            findings.append(Finding(
+                model.mod.rel, i.line, RULE_ITER,
+                f"lock-free iteration of {model.attr_label(attr)} in "
+                f"{_unit_label(model, iu)} (thread root {root_i}) while "
+                f"{_unit_label(model, wu)} (root {root_w}) mutates it — "
+                f"snapshot under a lock before iterating",
+            ))
+    return findings
+
+
+def check_races(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for model in build_models(project):
+        if model.owner.is_module or model.thread_owning:
+            findings.extend(_race001(model))
+            findings.extend(_race002(model))
+            findings.extend(_race004(model))
+            findings.extend(_race005(model))
+        # RACE003 also covers lock-owning classes WITHOUT their own
+        # thread entries: a shared object's callers can come from any
+        # thread, and a version check hoisted outside the lock that
+        # guards the counter is stale by commit time regardless of who
+        # owns the threads (the same altitude LOCK001 operates at)
+        findings.extend(_race003(model))
+    return findings

@@ -1,0 +1,1162 @@
+"""Shared thread-graph / happens-before engine (LOCK + RACE families).
+
+This module owns the concurrency model every lock/race rule builds on,
+factored out of the original ``rules/locks.py`` (ISSUE 7):
+
+- **Per-unit scanning** (:class:`MethodScan`): one ordered pass over a
+  method (or module-level function) body collecting attribute accesses
+  with the lexical lock state, call edges, lock acquisitions, blocking
+  calls — and, new for the RACE family, *sequence-numbered
+  synchronisation events* (``Thread.start/join``, ``Event.set/wait``,
+  per-object ``Queue.put/get``) plus iteration accesses (``for x in
+  self._coll`` and snapshot idioms like ``list(self._coll)``).
+- **Per-class analysis** (:class:`ClassAnalysis`): lock/exempt attr
+  inference, thread-entry discovery (``Thread(target=...)`` bound
+  methods AND nested defs — the fleet tick loop, the replica event
+  loop, the TCP accept/heartbeat/serve threads), acquire-wrapper
+  recognition, and interprocedural entry lock states
+  (:func:`analyse_units`).
+- **Module pseudo-class** (:class:`ModuleAnalysis`): a module's top
+  level viewed through the same lens — underscore module globals are
+  the "attributes", module-level ``Lock()`` assignments the locks,
+  module functions the methods (the telemetry handler table and the
+  native lazy-loader cache are real instances).
+- **Thread roots over the real import graph**
+  (:func:`thread_called_functions`, :func:`build_models`): every
+  thread-entry unit's body is walked and its calls resolved through the
+  project import table, so a module-level function reached from a
+  replica/fleet/transport thread (``telemetry.execute`` from the event
+  loop) is known to run on a non-caller root even though the module
+  itself starts no threads.
+- **Happens-before edges** (:func:`hb_ordered`): ``Thread.start``
+  (writes before ``start()`` are visible to the started thread),
+  ``Thread.join`` (everything the thread did is visible after the
+  join), ``Event.set → Event.wait`` and ``Queue.put → Queue.get`` on
+  the *same* object (message-passing handoff). ``Event.wait(timeout)``
+  is deliberately NOT an edge — a timed wait can return with nothing
+  set, so code ordered only by one is paced, not synchronised — and
+  put/get on *distinct* queue objects never synchronise each other.
+
+Documented model boundaries (shared by every consumer): ``__init__`` is
+pre-publication (neither mints guards nor races, except for the
+publish-after-``Thread.start`` window RACE004 checks); private methods
+never reached from any entry are assumed called under their caller's
+discipline; cross-class calls through injected collaborators are
+analysed in the collaborator's own class context.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable
+
+from tools.crdtlint.engine import ModuleInfo, Project
+from tools.crdtlint.rules import (
+    MUTATOR_METHODS,
+    THREADSAFE_CONSTRUCTORS,
+    call_leaf,
+    self_attr,
+)
+
+#: pseudo lock-state token for __init__-reachable code (pre-publication:
+#: single-threaded by construction, so neither flagged nor guard-minting)
+INIT = "<init>"
+
+#: the external-caller thread root: all public units run here (any
+#: thread the embedding program calls the object from)
+CALLER_ROOT = "<caller>"
+
+#: root for module functions reached from a thread entry in ANOTHER
+#: module (import-graph discovery: telemetry.execute from the replica
+#: event loop)
+XTHREAD_ROOT = "<cross-module-thread>"
+
+#: constructor leaves treated as Event-like (set/wait channel)
+EVENT_CTORS = {"Event"}
+
+#: constructor leaves treated as Queue-like (put/get channel)
+QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"}
+
+#: call leaves that block the calling thread regardless of receiver
+BLOCKING_LEAVES = {
+    "fsync": "os.fsync",
+    "sendall": "socket sendall",
+    "recv": "socket recv",
+    "accept": "socket accept",
+    "connect": "socket connect",
+    "create_connection": "socket connect",
+    "getaddrinfo": "DNS resolution",
+    "sleep": "time.sleep",
+    "block_until_ready": "device sync (block_until_ready)",
+    "fsync_dir": "os.fsync (directory)",
+}
+
+#: leaves that block only for specific receiver types — counted when the
+#: receiver is a ``self.`` attribute constructed as one of these
+BLOCKING_RECEIVER_LEAVES = {
+    "join": ("Thread",),
+    "wait": ("Event", "Condition", "Barrier"),
+}
+
+#: builtins whose call iterates its first argument (the snapshot idiom
+#: ``list(self._conns.values())`` — as much an iteration as a for loop)
+_ITERATING_BUILTINS = {
+    "list", "dict", "set", "frozenset", "tuple", "sorted", "sum", "min", "max",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Access:
+    method: str
+    line: int
+    attr: str
+    kind: str  # "read" | "write" | "call" | "iter"
+    held: frozenset  # lock attrs held lexically at this point
+    seq: int = 0  # statement-ordered position within the unit
+    leaf: str | None = None  # method name for kind == "call"
+    aug: bool = False  # AugAssign-Add write (version-counter shape)
+
+
+@dataclasses.dataclass(frozen=True)
+class CallEdge:
+    callee: str  # method name on self (or module-level function)
+    held: frozenset
+
+
+@dataclasses.dataclass(frozen=True)
+class AcquireEvent:
+    """One lock-acquisition site (``with self._x:`` or ``.acquire()``)
+    with the lexical lock state just BEFORE it — the raw material of the
+    LOCK002 acquisition-order graph."""
+
+    method: str
+    line: int
+    lock: str
+    held_before: frozenset
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockingEvent:
+    """A call that can block the thread (fsync, socket I/O, sleep,
+    thread join, device sync…) and the lexical lock state at the call —
+    LOCK003 flags those reachable with any lock held."""
+
+    method: str
+    line: int
+    what: str
+    held: frozenset
+
+
+@dataclasses.dataclass(frozen=True)
+class AttrCall:
+    """``self.X.m(...)`` — a method call on a member object. When X's
+    class is statically known (constructed in this class), LOCK002/003
+    follow the edge into that class's methods."""
+
+    method: str
+    line: int
+    attr: str
+    callee: str
+    held: frozenset
+
+
+@dataclasses.dataclass(frozen=True)
+class SyncEvent:
+    """One happens-before primitive in a unit body.
+
+    ``op`` ∈ {"start", "join"} with ``obj`` naming the target thread
+    ROOT (an entry unit name), or ``op`` ∈ {"set", "wait",
+    "wait_timeout", "put", "get"} with ``obj`` naming the Event/Queue
+    attribute (per-object channels: distinct attrs never synchronise
+    each other; a timed wait is pacing, not an edge)."""
+
+    method: str
+    line: int
+    seq: int
+    op: str
+    obj: str
+
+
+def _dotted_chain(node: ast.AST) -> str | None:
+    """``a.b.C`` attribute chain -> "a.b.C" (None when not a plain chain)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _thread_target(call: ast.Call) -> ast.AST | None:
+    """``Thread(target=X)`` -> the X expression (None otherwise)."""
+    if call_leaf(call) != "Thread":
+        return None
+    for kw in call.keywords:
+        if kw.arg == "target":
+            return kw.value
+    return None
+
+
+class MethodScan(ast.NodeVisitor):
+    """One ordered pass over a unit body collecting attribute accesses,
+    call edges, lock state, and synchronisation events.
+
+    Lock state tracking is statement-ordered: a ``with self._lock:``
+    holds inside its body; ``self._lock.acquire(...)`` (or a call to an
+    acquire-wrapper method) holds until ``self._lock.release()`` in the
+    same or an outer suite. Nested function defs are analysed inline at
+    their definition point (closures run with whatever lock state their
+    caller establishes — conservative for callbacks, exact for the
+    immediately-called lambda idiom), except thread-entry defs, which
+    the class analysis lifts into separate lock-free entry points.
+
+    Works for class methods (attributes are ``self._*``) and, through
+    :class:`ModuleAnalysis`, for module-level functions (attributes are
+    underscore module globals; names shadowed by function locals are
+    excluded per unit).
+    """
+
+    def __init__(self, cls: "ClassAnalysis | ModuleAnalysis", method: str,
+                 skip_defs: set[ast.AST]):
+        self.cls = cls
+        self.method = method
+        self.skip_defs = skip_defs
+        self.module_mode = getattr(cls, "is_module", False)
+        self.held: set[str] = set()
+        self.accesses: list[Access] = []
+        self.iters: list[Access] = []
+        self.edges: list[CallEdge] = []
+        self.acquires: list[AcquireEvent] = []
+        self.blocking: list[BlockingEvent] = []
+        self.attr_calls: list[AttrCall] = []
+        self.syncs: list[SyncEvent] = []
+        self._seq = 0
+        #: local variable -> thread root name (``t = Thread(target=f)``)
+        self._local_threads: dict[str, str] = {}
+        #: nested-def name -> entry unit name within THIS unit
+        self._nested_names: dict[str, str] = {}
+        #: module mode: globals shadowed by function locals in this unit
+        self._shadowed: set[str] = set()
+
+    # -- attr extraction (class: self._x; module: underscore global) ---
+
+    def _attr(self, node: ast.AST) -> str | None:
+        if self.module_mode:
+            if isinstance(node, ast.Name) and node.id in self.cls.trackable:
+                return node.id if node.id not in self._shadowed else None
+            return None
+        return self_attr(node)
+
+    def _receiver_root(self, func: ast.AST) -> str | None:
+        """Root attr of a call-receiver chain: ``self._x.m(...)``,
+        ``self._x[k].m(...)``, ``_g[k].append(...)`` all root at the
+        tracked attribute/global."""
+        if not isinstance(func, ast.Attribute):
+            return None
+        node = func.value
+        while True:
+            got = self._attr(node)
+            if got is not None:
+                return got
+            if isinstance(node, ast.Attribute):
+                node = node.value
+            elif isinstance(node, ast.Subscript):
+                node = node.value
+            elif isinstance(node, ast.Call):
+                node = node.func
+            else:
+                return None
+
+    # -- lock state ----------------------------------------------------
+
+    def _is_lock_attr(self, node: ast.AST) -> str | None:
+        attr = self._attr(node)
+        return attr if attr in self.cls.lock_attrs else None
+
+    def visit_With(self, node: ast.With) -> None:
+        entered: list[str] = []
+        for item in node.items:
+            lock = self._is_lock_attr(item.context_expr)
+            if lock is not None:
+                self.acquires.append(AcquireEvent(
+                    self.method, item.context_expr.lineno, lock,
+                    frozenset(self.held),
+                ))
+                # only locks not already held: a nested reentrant
+                # ``with self._lock:`` (RLock) must not release the
+                # outer hold when the inner block exits
+                if lock not in self.held:
+                    entered.append(lock)
+            else:
+                self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        self.held.update(entered)
+        for stmt in node.body:
+            self.visit(stmt)
+        for lock in entered:
+            self.held.discard(lock)
+
+    visit_AsyncWith = visit_With
+
+    @staticmethod
+    def _terminates(stmts: list[ast.stmt]) -> bool:
+        return bool(stmts) and isinstance(
+            stmts[-1], (ast.Raise, ast.Return, ast.Continue, ast.Break)
+        )
+
+    def visit_If(self, node: ast.If) -> None:
+        # branch-merge: a lock acquired in only one branch is not held
+        # after the join (the acquire-then-raise guard idiom keeps its
+        # lock because the acquiring branch is the TEST, visited first,
+        # and a terminating branch contributes nothing to the join)
+        self.visit(node.test)
+        pre = set(self.held)
+        self.held = set(pre)
+        for s in node.body:
+            self.visit(s)
+        body_held = self.held
+        self.held = set(pre)
+        for s in node.orelse:
+            self.visit(s)
+        else_held = self.held
+        if self._terminates(node.body):
+            self.held = else_held
+        elif node.orelse and self._terminates(node.orelse):
+            self.held = body_held
+        else:
+            self.held = body_held & else_held
+
+    def _visit_loop(self, node) -> None:
+        # a loop body may run zero times: locks acquired (or released)
+        # inside don't survive the loop — intersect with the pre-state
+        pre = set(self.held)
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self.held &= pre
+
+    visit_AsyncFor = _visit_loop
+    visit_While = _visit_loop
+
+    def visit_For(self, node: ast.For) -> None:
+        self._record_iter(node.iter)
+        self._visit_loop(node)
+
+    def _comprehension(self, node) -> None:
+        for gen in node.generators:
+            self._record_iter(gen.iter)
+        self.generic_visit(node)
+
+    visit_ListComp = _comprehension
+    visit_SetComp = _comprehension
+    visit_DictComp = _comprehension
+    visit_GeneratorExp = _comprehension
+
+    def _iter_attr(self, node: ast.AST) -> str | None:
+        """``self._x`` / ``self._x.items()/.values()/.keys()`` -> attr."""
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in {"items", "values", "keys"}
+            and not node.args
+        ):
+            node = node.func.value
+        return self._attr(node)
+
+    def _record_iter(self, iter_expr: ast.AST) -> None:
+        attr = self._iter_attr(iter_expr)
+        if attr is not None:
+            self._record(attr, iter_expr.lineno, "iter")
+
+    def _note_blocking(self, func: "ast.Attribute | ast.Name", line: int) -> None:
+        leaf = func.attr if isinstance(func, ast.Attribute) else func.id
+        what = BLOCKING_LEAVES.get(leaf)
+        if what is None and isinstance(func, ast.Attribute):
+            # receiver-typed blockers: thread join, event/condition wait
+            ctors = BLOCKING_RECEIVER_LEAVES.get(leaf)
+            if ctors:
+                recv = self._attr(func.value)
+                chain = self.cls.attr_ctors.get(recv) if recv is not None else None
+                ctor = chain.rsplit(".", 1)[-1] if chain else None
+                if ctor in ctors:
+                    what = f"{ctor}.{leaf}"
+        if what is not None:
+            self.blocking.append(
+                BlockingEvent(self.method, line, what, frozenset(self.held))
+            )
+
+    # -- sync events ---------------------------------------------------
+
+    def _sync(self, op: str, obj: str, line: int) -> None:
+        self._seq += 1
+        self.syncs.append(SyncEvent(self.method, line, self._seq, op, obj))
+
+    def _thread_root_of(self, target: ast.AST) -> str | None:
+        """``Thread(target=X)`` target expression -> entry unit name."""
+        attr = self_attr(target) if not self.module_mode else None
+        if attr is not None and attr in self.cls.methods:
+            return attr
+        if isinstance(target, ast.Name):
+            if target.id in self._nested_names:
+                return self._nested_names[target.id]
+            if self.module_mode and target.id in self.cls.methods:
+                return target.id
+        return None
+
+    def _note_thread_sync(self, node: ast.Call, func: ast.Attribute) -> bool:
+        """``X.start()`` / ``X.join(...)`` where X is a known thread:
+        a self attr assigned a Thread, a local assigned one, or an
+        inline ``Thread(target=...)``. join(timeout=...) still counts —
+        the teardown idiom this tree uses everywhere — but a timed
+        ``Event.wait`` does not (see module docstring)."""
+        if func.attr not in ("start", "join"):
+            return False
+        recv = func.value
+        root = None
+        direct = self_attr(recv) if not self.module_mode else None
+        if direct is not None:
+            root = self.cls.thread_attr_targets.get(direct)
+        elif isinstance(recv, ast.Name):
+            root = self._local_threads.get(recv.id)
+        elif isinstance(recv, ast.Call):
+            target = _thread_target(recv)
+            if target is not None:
+                root = self._thread_root_of(target)
+        if root is None:
+            return False
+        self._sync(func.attr, root, node.lineno)
+        return False  # informational: the call still flows through visit_Call
+
+    def _note_channel_sync(self, node: ast.Call, func: ast.Attribute) -> None:
+        direct = self._attr(func.value)
+        if direct is None:
+            return
+        chain = self.cls.attr_ctors.get(direct)
+        ctor = chain.rsplit(".", 1)[-1] if chain else None
+        if ctor in EVENT_CTORS:
+            if func.attr == "set":
+                self._sync("set", direct, node.lineno)
+            elif func.attr == "wait":
+                timed = bool(node.args or node.keywords)
+                self._sync("wait_timeout" if timed else "wait", direct, node.lineno)
+        elif ctor in QUEUE_CTORS:
+            if func.attr in ("put", "put_nowait"):
+                self._sync("put", direct, node.lineno)
+            elif func.attr in ("get", "get_nowait"):
+                self._sync("get", direct, node.lineno)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # track ``t = Thread(target=f)`` locals for start/join edges
+        if isinstance(node.value, ast.Call):
+            target_expr = _thread_target(node.value)
+            if target_expr is not None:
+                root = self._thread_root_of(target_expr)
+                if root is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self._local_threads[t.id] = root
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            self._note_thread_sync(node, func)
+            self._note_channel_sync(node, func)
+            lock = self._is_lock_attr(func.value)
+            if lock is not None:
+                if func.attr == "acquire":
+                    self.acquires.append(AcquireEvent(
+                        self.method, node.lineno, lock, frozenset(self.held)
+                    ))
+                    self.held.add(lock)
+                elif func.attr == "release":
+                    self.held.discard(lock)
+                for arg in node.args + [kw.value for kw in node.keywords]:
+                    self.visit(arg)
+                return
+            callee = self_attr(func) if not self.module_mode else None
+            if callee is not None and callee in self.cls.methods:
+                # self.helper(...): record the call edge; an acquire-
+                # wrapper helper (net-acquires, e.g. Replica._acquire)
+                # flips our lexical state exactly like a raw acquire()
+                self.edges.append(CallEdge(callee, frozenset(self.held)))
+                for arg in node.args + [kw.value for kw in node.keywords]:
+                    self.visit(arg)
+                self.held.update(self.cls.acquire_wrappers.get(callee, set()))
+                return
+            self._note_blocking(func, node.lineno)
+            recv = self._receiver_root(func)
+            if recv is not None:
+                # method call rooted at a tracked attribute: potential
+                # in-place mutation of that attribute's object
+                self._record(recv, func.lineno, "call", leaf=func.attr)
+                direct = self._attr(func.value)
+                if direct is not None:
+                    self.attr_calls.append(AttrCall(
+                        self.method, node.lineno, direct, func.attr,
+                        frozenset(self.held),
+                    ))
+                self.visit(func.value)
+                for arg in node.args + [kw.value for kw in node.keywords]:
+                    self.visit(arg)
+                return
+        elif isinstance(func, ast.Name):
+            self._note_blocking(func, node.lineno)
+            if func.id in _ITERATING_BUILTINS and node.args:
+                self._record_iter(node.args[0])
+            if self.module_mode and func.id in self.cls.methods:
+                self.edges.append(CallEdge(func.id, frozenset(self.held)))
+        self.generic_visit(node)
+
+    # -- accesses ------------------------------------------------------
+
+    def _record(self, attr: str, line: int, kind: str,
+                leaf: str | None = None, aug: bool = False) -> None:
+        if attr in self.cls.exempt_attrs or not attr.startswith("_"):
+            return
+        if attr in self.cls.methods or attr in self.cls.thread_entries:
+            return  # bound-method reference, not state
+        self._seq += 1
+        acc = Access(self.method, line, attr, kind, frozenset(self.held),
+                     self._seq, leaf, aug)
+        (self.iters if kind == "iter" else self.accesses).append(acc)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = self._attr(node)
+        if attr is not None:
+            kind = "write" if isinstance(node.ctx, (ast.Store, ast.Del)) else "read"
+            self._record(attr, node.lineno, kind)
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if self.module_mode:
+            attr = self._attr(node)
+            if attr is not None:
+                kind = "write" if isinstance(node.ctx, (ast.Store, ast.Del)) else "read"
+                self._record(attr, node.lineno, kind)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # self._x[k] = v / del self._x[k]: the Attribute itself is Load,
+        # but the container is mutated — count a write
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            attr = self._attr(node.value)
+            if attr is not None:
+                self._record(attr, node.lineno, "write")
+                self.visit(node.slice)
+                return
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        attr = self._attr(node.target)
+        if attr is not None:
+            self._record(attr, node.lineno, "write",
+                         aug=isinstance(node.op, ast.Add))
+            self.visit(node.value)
+            return
+        self.generic_visit(node)
+
+    # -- nested defs ---------------------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node in self.skip_defs:
+            return  # analysed separately as a thread entry
+        for stmt in node.body:  # inline: closures see the caller's locks
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self.visit(node.body)
+
+
+class ClassAnalysis:
+    def __init__(self, mod: ModuleInfo, node: ast.ClassDef):
+        self.mod = mod
+        self.node = node
+        self.name = node.name
+        self.is_module = False
+        # keyed by a UNIQUE unit name: a class may define several defs
+        # under one name (property getter + setter/deleter overloads) —
+        # a plain name-keyed dict would shadow all but the last, leaving
+        # e.g. a property getter's lock region entirely unanalysed
+        self.methods: dict[str, ast.FunctionDef] = {}
+        for n in node.body:
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            name = n.name
+            k = 2
+            while name in self.methods:
+                name = f"{n.name}#{k}"  # "#k" never collides with real names
+                k += 1
+            self.methods[name] = n
+        self.lock_attrs = self._find_constructed(("Lock", "RLock"))
+        self.exempt_attrs = self._find_constructed(tuple(THREADSAFE_CONSTRUCTORS))
+        self.exempt_attrs |= self.lock_attrs
+        #: attr -> constructor leaf name for attrs assigned a direct
+        #: ``self.x = Ctor(...)`` (receiver-typed blocking + the
+        #: cross-class edges of the LOCK002/003 order analysis)
+        self.attr_ctors: dict[str, str] = self._find_attr_ctors()
+        # thread-entry units: entry name -> FunctionDef (bound methods
+        # and nested defs passed as Thread(target=...))
+        self.thread_entries: dict[str, ast.FunctionDef] = {}
+        self.nested_entry_defs: set[ast.AST] = set()
+        #: attr -> entry unit name for ``self._t = Thread(target=...)``
+        #: (so ``self._t.start()/.join()`` resolve to start/join edges)
+        self.thread_attr_targets: dict[str, str] = {}
+        self._find_thread_entries()
+        # methods that net-acquire a lock for their caller
+        self.acquire_wrappers: dict[str, set[str]] = self._find_acquire_wrappers()
+
+    def _find_constructed(self, ctor_names: tuple[str, ...]) -> set[str]:
+        out: set[str] = set()
+        for body_fn in self.methods.values():
+            for stmt in ast.walk(body_fn):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+                value = stmt.value
+                if not isinstance(value, ast.Call):
+                    continue
+                leaf = (
+                    value.func.attr
+                    if isinstance(value.func, ast.Attribute)
+                    else value.func.id if isinstance(value.func, ast.Name) else None
+                )
+                if leaf not in ctor_names:
+                    continue
+                for t in targets:
+                    attr = self_attr(t)
+                    if attr is not None:
+                        out.add(attr)
+        return out
+
+    def _find_attr_ctors(self) -> dict[str, str]:
+        """attr -> constructor dotted chain (``WalLog`` / ``wal.WalLog``
+        / ``threading.Thread``) for direct ``self.x = Ctor(...)``
+        assignments. Consumers compare the LEAF for receiver typing and
+        resolve the full chain for cross-class edges."""
+        out: dict[str, str] = {}
+        for body_fn in self.methods.values():
+            for stmt in ast.walk(body_fn):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+                value = stmt.value
+                if not isinstance(value, ast.Call):
+                    continue
+                chain = (
+                    value.func.id
+                    if isinstance(value.func, ast.Name)
+                    else _dotted_chain(value.func)
+                )
+                if chain is None:
+                    continue
+                for t in targets:
+                    attr = self_attr(t)
+                    if attr is not None:
+                        out[attr] = chain
+        return out
+
+    def _find_thread_entries(self) -> None:
+        for mname, body_fn in self.methods.items():
+            nested = {
+                n.name: n
+                for n in ast.walk(body_fn)
+                if isinstance(n, ast.FunctionDef) and n is not body_fn
+            }
+            for call in ast.walk(body_fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                target = _thread_target(call)
+                if target is None:
+                    continue
+                entry_name = None
+                tgt_attr = self_attr(target)
+                if tgt_attr is not None and tgt_attr in self.methods:
+                    entry_name = tgt_attr
+                    self.thread_entries[tgt_attr] = self.methods[tgt_attr]
+                elif isinstance(target, ast.Name) and target.id in nested:
+                    entry = nested[target.id]
+                    entry_name = f"{mname}.<{entry.name}>"
+                    self.thread_entries[entry_name] = entry
+                    self.nested_entry_defs.add(entry)
+                if entry_name is None:
+                    continue
+                # ``self._t = Thread(target=...)``: remember the attr so
+                # later ``self._t.start()/.join()`` resolve to this root
+                parent = self._assign_parent(body_fn, call)
+                if parent is not None:
+                    for t in parent.targets:
+                        attr = self_attr(t)
+                        if attr is not None:
+                            self.thread_attr_targets[attr] = entry_name
+
+    @staticmethod
+    def _assign_parent(body_fn: ast.AST, call: ast.Call) -> ast.Assign | None:
+        for stmt in ast.walk(body_fn):
+            if isinstance(stmt, ast.Assign) and stmt.value is call:
+                return stmt
+        return None
+
+    def _find_acquire_wrappers(self) -> dict[str, set[str]]:
+        out: dict[str, set[str]] = {}
+        for mname, body_fn in self.methods.items():
+            acquired: set[str] = set()
+            released: set[str] = set()
+            for call in ast.walk(body_fn):
+                if not isinstance(call, ast.Call) or not isinstance(
+                    call.func, ast.Attribute
+                ):
+                    continue
+                lock = self_attr(call.func.value)
+                if lock in self.lock_attrs:
+                    if call.func.attr == "acquire":
+                        acquired.add(lock)
+                    elif call.func.attr == "release":
+                        released.add(lock)
+            net = acquired - released
+            if net:
+                out[mname] = net
+        return out
+
+
+class ModuleAnalysis:
+    """A module's top level through the class lens: underscore globals
+    are the attributes, module-level ``Lock()`` assignments the locks,
+    module functions the methods. The telemetry handler table
+    (``_handlers`` + ``_lock``) and the native lazy-loader cache
+    (``_lib``/``_tried`` + ``_lock``) are the real instances this
+    models."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.node = mod.tree
+        self.name = "<module>"
+        self.is_module = True
+        self.methods: dict[str, ast.FunctionDef] = dict(mod.functions)
+        self.lock_attrs: set[str] = set()
+        self.exempt_attrs: set[str] = set()
+        self.attr_ctors: dict[str, str] = {}
+        self.trackable: set[str] = set()
+        self.acquire_wrappers: dict[str, set[str]] = {}
+        for stmt in mod.tree.body:
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            names = [t.id for t in targets
+                     if isinstance(t, ast.Name) and t.id.startswith("_")]
+            if not names:
+                continue
+            value = stmt.value
+            if isinstance(value, ast.Call):
+                chain = (
+                    value.func.id if isinstance(value.func, ast.Name)
+                    else _dotted_chain(value.func)
+                )
+                if chain is not None:
+                    leaf = chain.rsplit(".", 1)[-1]
+                    for n in names:
+                        self.attr_ctors[n] = chain
+                    if leaf in ("Lock", "RLock"):
+                        self.lock_attrs.update(names)
+                    if leaf in THREADSAFE_CONSTRUCTORS:
+                        self.exempt_attrs.update(names)
+            self.trackable.update(names)
+        # names rebound via ``global`` inside functions are trackable
+        # even when the top-level binding is a plain constant
+        for fn in self.methods.values():
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Global):
+                    self.trackable.update(
+                        g for g in n.names if g.startswith("_"))
+        self.exempt_attrs |= self.lock_attrs
+        self.trackable -= {n for n in self.trackable if n in self.methods}
+        self.thread_entries: dict[str, ast.FunctionDef] = {}
+        self.nested_entry_defs: set[ast.AST] = set()
+        self.thread_attr_targets: dict[str, str] = {}
+        self._find_thread_entries()
+
+    def _find_thread_entries(self) -> None:
+        for mname, body_fn in self.methods.items():
+            nested = {
+                n.name: n
+                for n in ast.walk(body_fn)
+                if isinstance(n, ast.FunctionDef) and n is not body_fn
+            }
+            for call in ast.walk(body_fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                target = _thread_target(call)
+                if target is None or not isinstance(target, ast.Name):
+                    continue
+                if target.id in nested:
+                    entry = nested[target.id]
+                    self.thread_entries[f"{mname}.<{entry.name}>"] = entry
+                    self.nested_entry_defs.add(entry)
+                elif target.id in self.methods:
+                    self.thread_entries[target.id] = self.methods[target.id]
+
+
+def scan_unit(cls: "ClassAnalysis | ModuleAnalysis", unit_name: str,
+              fn: ast.FunctionDef) -> MethodScan:
+    scan = MethodScan(cls, unit_name, cls.nested_entry_defs)
+    scan._nested_names = {
+        n.name: f"{unit_name}.<{n.name}>"
+        for n in ast.walk(fn)
+        if isinstance(n, ast.FunctionDef) and n is not fn
+    }
+    if scan.module_mode:
+        # function locals shadow same-named globals unless declared
+        # ``global`` — their accesses are local state, not shared
+        declared = {
+            g for n in ast.walk(fn) if isinstance(n, ast.Global) for g in n.names
+        }
+        assigned: set[str] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store, ast.Del)):
+                assigned.add(n.id)
+            elif isinstance(n, ast.arg):
+                assigned.add(n.arg)
+        scan._shadowed = assigned - declared
+    for stmt in fn.body:
+        scan.visit(stmt)
+    return scan
+
+
+def analyse_units(
+    cls: "ClassAnalysis | ModuleAnalysis",
+    extra_entries: Iterable[str] = (),
+) -> tuple[dict[str, MethodScan], dict[str, set[frozenset]]]:
+    """Scan every unit (method or thread entry) of one class and
+    propagate entry lock states interprocedurally: public methods and
+    thread entries start lock-free, ``__init__`` gets the INIT
+    pseudo-state (pre-publication), and each call edge forwards
+    caller-entry ∪ call-site lexical locks to the callee. Shared by
+    LOCK001 (guard inference), LOCK002/003 (order/blocking) and the
+    RACE family. ``extra_entries`` seeds additional lock-free entry
+    units (import-graph thread-called module functions)."""
+    units: dict[str, ast.FunctionDef] = dict(cls.methods)
+    units.update(cls.thread_entries)
+    scans = {name: scan_unit(cls, name, fn) for name, fn in units.items()}
+
+    entry_states: dict[str, set[frozenset]] = {name: set() for name in units}
+    for name in units:
+        if name in cls.thread_entries or not name.startswith("_"):
+            entry_states[name].add(frozenset())
+    for name in extra_entries:
+        if name in entry_states:
+            entry_states[name].add(frozenset())
+    if "__init__" in entry_states:
+        entry_states["__init__"] = {frozenset({INIT})}
+
+    # propagate: caller entry-state ∪ call-site lexical locks -> callee
+    changed = True
+    guard = 0
+    while changed and guard < 10_000:
+        changed = False
+        guard += 1
+        for name, scan in scans.items():
+            for entry in list(entry_states[name]):
+                for edge in scan.edges:
+                    if edge.callee not in entry_states:
+                        continue
+                    state = frozenset(entry | edge.held)
+                    if state not in entry_states[edge.callee]:
+                        entry_states[edge.callee].add(state)
+                        changed = True
+    return scans, entry_states
+
+
+# ----------------------------------------------------------------------
+# thread roots + the concurrency model the RACE family consumes
+
+def infer_guards(
+    scans: dict[str, MethodScan],
+    entry_states: dict[str, set[frozenset]],
+) -> dict[str, set[str]]:
+    """Guard inference shared by LOCK001 and RACE003: attr -> set of
+    locks it is written under somewhere (post-init, kind write/call,
+    any reachable entry state)."""
+    guards: dict[str, set[str]] = {}
+    for name, scan in scans.items():
+        for entry in entry_states.get(name, ()):
+            if INIT in entry:
+                continue
+            for acc in scan.accesses:
+                if acc.kind in ("write", "call"):
+                    held = entry | acc.held
+                    if held:
+                        guards.setdefault(acc.attr, set()).update(held)
+    return guards
+
+
+@dataclasses.dataclass
+class ConcurrencyModel:
+    """One class (or module top level) with its units assigned to
+    thread roots and per-unit scans/entry-states resolved.
+
+    ``thread_owning`` is False for lock-owning classes with no thread
+    entries of their own: they get no cross-root pairs (single caller
+    root), but RACE003's check-then-act still applies — their callers
+    can come from any thread."""
+
+    mod: ModuleInfo
+    owner: "ClassAnalysis | ModuleAnalysis"
+    scans: dict[str, MethodScan]
+    entry_states: dict[str, set[frozenset]]
+    roots: dict[str, frozenset]  # unit -> thread roots it runs on
+    thread_owning: bool = True
+
+    @property
+    def owner_name(self) -> str:
+        return self.owner.name
+
+    def attr_label(self, attr: str) -> str:
+        return attr if self.owner.is_module else f"self.{attr}"
+
+    def effective_locks(self, unit: str, acc: Access) -> frozenset | None:
+        """Locks held at this access on EVERY (non-init) path reaching
+        its unit — the set a common-lock argument may rely on. None
+        when the access is unreachable outside ``__init__``."""
+        states = [s for s in self.entry_states.get(unit, ()) if INIT not in s]
+        if not states:
+            return None
+        out: frozenset | None = None
+        for s in states:
+            held = frozenset(s | acc.held)
+            out = held if out is None else out & held
+        return out
+
+    def accesses_of(self, attr: str, include_iters: bool = False):
+        """Yield ``(unit, Access, roots, locks)`` for every reachable
+        post-init access of ``attr`` in a rooted unit."""
+        for unit, scan in self.scans.items():
+            roots = self.roots.get(unit)
+            if not roots:
+                continue
+            pool = scan.accesses + (scan.iters if include_iters else [])
+            for acc in pool:
+                if acc.attr != attr:
+                    continue
+                locks = self.effective_locks(unit, acc)
+                if locks is None:
+                    continue
+                yield unit, acc, roots, locks
+
+    def tracked_attrs(self) -> set[str]:
+        out: set[str] = set()
+        for scan in self.scans.values():
+            out.update(a.attr for a in scan.accesses)
+            out.update(a.attr for a in scan.iters)
+        return out
+
+
+def is_race_write(acc: Access) -> bool:
+    """The RACE family's write notion: plain/augmented/subscript stores
+    and deletes, plus calls of KNOWN mutator methods. Unlike LOCK001's
+    guard inference (where any method call conservatively counts — it
+    only widens an existing lock's guard set), an unknown method call is
+    NOT assumed mutating here: call-counts-as-write would flood
+    cross-thread socket/file shutdown idioms with false races."""
+    if acc.kind == "write":
+        return True
+    return acc.kind == "call" and acc.leaf in MUTATOR_METHODS
+
+
+def unit_roots(
+    owner: "ClassAnalysis | ModuleAnalysis",
+    scans: dict[str, MethodScan],
+    thread_called: set[str] = frozenset(),
+) -> dict[str, frozenset]:
+    """unit -> set of thread roots it can run on. Seeds: every public
+    unit runs on the external-caller root; every thread entry is its
+    own root; import-graph thread-called module functions additionally
+    run on the cross-module-thread root. BFS over self-call edges.
+    ``__init__`` is pre-publication and rootless; private units never
+    reached from any root are assumed called under their caller's
+    discipline (same convention as LOCK001) and excluded."""
+    seeds: dict[str, set[str]] = {}
+    for name in scans:
+        if name == "__init__" or name.startswith("__init__#"):
+            continue
+        if name in owner.thread_entries or ".<" in name:
+            continue  # a thread body is its own root, not a caller API
+        if not name.startswith("_"):
+            seeds.setdefault(CALLER_ROOT, set()).add(name)
+    for entry in owner.thread_entries:
+        seeds.setdefault(entry, set()).add(entry)
+    for name in thread_called:
+        if name in scans:
+            seeds.setdefault(XTHREAD_ROOT, set()).add(name)
+    out: dict[str, set] = {}
+    for root, seed_units in seeds.items():
+        stack = list(seed_units)
+        visited: set[str] = set()
+        while stack:
+            u = stack.pop()
+            if u in visited or u not in scans:
+                continue
+            visited.add(u)
+            if u == "__init__":
+                continue  # ctor runs pre-publication even when self-called
+            out.setdefault(u, set()).add(root)
+            stack.extend(e.callee for e in scans[u].edges)
+    return {u: frozenset(r) for u, r in out.items()}
+
+
+def thread_called_functions(
+    project: Project,
+    class_units: list[tuple[ModuleInfo, ast.FunctionDef]],
+) -> dict[str, set[str]]:
+    """module name -> module-level function names reachable from a
+    thread-entry unit anywhere in the project, resolved over the real
+    import graph (``telemetry.execute`` called from the replica event
+    loop). Worklist-propagated: a thread-called module function's own
+    calls are thread-called too."""
+    marked: dict[str, set[str]] = {}
+    seen: set[int] = set()
+    work: list[tuple[ModuleInfo, ast.FunctionDef]] = list(class_units)
+    while work:
+        mod, fn = work.pop()
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            resolved = project.resolve_function(mod, call.func)
+            if resolved is None:
+                continue
+            tmod, tfn = resolved
+            if tfn.name in marked.setdefault(tmod.name, set()):
+                continue
+            marked[tmod.name].add(tfn.name)
+            work.append((tmod, tfn))
+    return marked
+
+
+def build_models(project: Project) -> list[ConcurrencyModel]:
+    """The project's full concurrency picture: one model per class that
+    owns thread entries, plus one per module whose underscore globals
+    are reachable from more than one thread root (its own entries, or
+    import-graph thread-called functions)."""
+    class_infos: list[tuple[ModuleInfo, ClassAnalysis]] = []
+    for name in sorted(project.modules):
+        mod = project.modules[name]
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                class_infos.append((mod, ClassAnalysis(mod, node)))
+
+    models: list[ConcurrencyModel] = []
+    for mod, ca in class_infos:
+        if not ca.thread_entries and not ca.lock_attrs:
+            continue  # single external root, no locks: nothing to check
+        scans, entry_states = analyse_units(ca)
+        roots = unit_roots(ca, scans)
+        models.append(ConcurrencyModel(
+            mod, ca, scans, entry_states, roots,
+            thread_owning=bool(ca.thread_entries),
+        ))
+
+    # seed the import-graph worklist with every unit that runs on a
+    # thread root anywhere: the entry bodies themselves plus everything
+    # they reach through self-calls (telemetry.execute is called from
+    # Replica._commit_entries_group, three self-call hops below the
+    # event loop — seeding only entry bodies would miss it)
+    seed_units: list[tuple[ModuleInfo, ast.FunctionDef]] = []
+    for model in models:
+        owner = model.owner
+        fns: dict[str, ast.FunctionDef] = dict(owner.methods)
+        fns.update(owner.thread_entries)
+        for unit, roots in model.roots.items():
+            if any(r != CALLER_ROOT for r in roots) and unit in fns:
+                seed_units.append((model.mod, fns[unit]))
+    module_infos: list[ModuleAnalysis] = []
+    for name in sorted(project.modules):
+        ma = ModuleAnalysis(project.modules[name])
+        module_infos.append(ma)
+        seed_units.extend((ma.mod, fn) for fn in ma.thread_entries.values())
+    thread_called = thread_called_functions(project, seed_units)
+    for ma in module_infos:
+        called = thread_called.get(ma.mod.name, set())
+        called = {c for c in called if c in ma.methods}
+        has_entries = bool(ma.thread_entries)
+        # a module matters when its own functions start threads (closure
+        # escapes — RACE002 — even with no globals), or when its globals
+        # can be reached from a cross-module thread root
+        if not has_entries and not (ma.trackable and called):
+            continue
+        scans, entry_states = analyse_units(ma, extra_entries=called)
+        roots = unit_roots(ma, scans, thread_called=called)
+        if not has_entries and len(
+            {r for rs in roots.values() for r in rs}
+        ) < 2:
+            continue  # every rooted unit on one root: no cross-thread pairs
+        models.append(ConcurrencyModel(ma.mod, ma, scans, entry_states, roots))
+    return models
+
+
+# ----------------------------------------------------------------------
+# happens-before
+
+def hb_ordered(
+    model: ConcurrencyModel,
+    w_unit: str, w_seq: int, w_root: str,
+    a_unit: str, a_seq: int, a_root: str,
+) -> bool:
+    """True when the access at (w_unit, w_seq) running on ``w_root`` is
+    ordered BEFORE the access at (a_unit, a_seq) on ``a_root`` by a
+    recognised happens-before edge:
+
+    - **start**: w's unit starts thread root ``a_root`` after w — the
+      started thread sees everything sequenced before its start().
+    - **join**: a's unit joined thread root ``w_root`` before a —
+      everything the joined thread did is visible after the join
+      (timeout joins included: the teardown idiom; see module doc).
+    - **channel**: an ``Event.set``/``Queue.put`` on object C after w
+      in w's unit pairs with an untimed ``Event.wait``/``Queue.get`` on
+      the SAME object C before a in a's unit. Distinct channel objects
+      never synchronise each other, and ``wait(timeout)`` is pacing,
+      not an edge.
+    """
+    w_syncs = model.scans[w_unit].syncs if w_unit in model.scans else []
+    a_syncs = model.scans[a_unit].syncs if a_unit in model.scans else []
+    for s in w_syncs:
+        if s.op == "start" and s.obj == a_root and s.seq > w_seq:
+            return True
+    for s in a_syncs:
+        if s.op == "join" and s.obj == w_root and s.seq < a_seq:
+            return True
+    # put pairs with get, set with wait — on the SAME channel object
+    for rel_op, acq_op in (("set", "wait"), ("put", "get")):
+        rel_objs = {s.obj for s in w_syncs if s.op == rel_op and s.seq >= w_seq}
+        if rel_objs and any(
+            s.op == acq_op and s.seq <= a_seq and s.obj in rel_objs
+            for s in a_syncs
+        ):
+            return True
+    return False
+
+
+def pair_unordered(
+    model: ConcurrencyModel,
+    w_unit: str, w: Access, w_roots: frozenset,
+    a_unit: str, a: Access, a_roots: frozenset,
+) -> "tuple[str, str] | None":
+    """The first root pair (w on A, a on B, A != B) under which the two
+    accesses are concurrent and unordered in both directions — or None
+    when every cross-root pairing is happens-before ordered."""
+    for ra in sorted(w_roots):
+        for rb in sorted(a_roots):
+            if ra == rb:
+                continue
+            if hb_ordered(model, w_unit, w.seq, ra, a_unit, a.seq, rb):
+                continue
+            if hb_ordered(model, a_unit, a.seq, rb, w_unit, w.seq, ra):
+                continue
+            return ra, rb
+    return None
