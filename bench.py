@@ -906,6 +906,16 @@ def bench_catchup():
             res["chunks_applied_reconnect"] = (
                 b.stats()["catchup"]["chunks_applied"] - chunks0
             )
+            # serving-side transfer-padding accounting, captured before
+            # the universe root (and with it the writer's WAL) goes away
+            srv = a.stats()["catchup"]
+            res["served"] = {
+                k: srv[k]
+                for k in (
+                    "store", "chunks_served", "bytes_shipped",
+                    "lanes_shipped", "entries_shipped", "chunk_fill_ratio",
+                )
+            }
             assert b.read() == a.read()
             return res, a, b
         finally:
@@ -950,6 +960,10 @@ def bench_catchup():
             "digest_walk": res_walk,
             "chunks_applied": cu["chunks_applied"],
             "horizon_fallbacks": cu["horizon_fallbacks"],
+            # per-store transfer-padding accounting (ISSUE 8 satellite:
+            # the PR 4 "chunk bytes ~2x the walk's" finding is padding —
+            # alive entries per shipped lane; 1.0 = dense extraction)
+            "served": res_log["served"],
             "round_speedup": round(res_walk["rounds"] / max(res_log["rounds"], 1), 3),
             "wall_speedup": round(res_walk["wall_s"] / max(res_log["wall_s"], 1e-9), 3),
             "parity": "bit_for_bit_state_checked",
@@ -1139,6 +1153,309 @@ def bench_fleet():
         "rounds": rounds,
         "keys_per_round": keys_per_round,
         "tree_depth": depth,
+        "backend": "cpu",
+    })
+
+
+# ---------------------------------------------------------------------------
+# hash-table dot store vs binned store (ISSUE 8)
+
+def bench_hashstore():
+    """``--hashstore``: the open-addressing hash-table dot store against
+    the binned row store — ingest merges/sec, growth-event counts, and
+    extracted wire bytes, with the bit-for-bit parity gate asserted
+    in-run.
+
+    Three phases over two symmetric universes (hash↔hash and
+    binned↔binned, one seeded script):
+
+    1. **load** — N senders bulk-load ``BENCH_HASHSTORE_KEYS`` keys
+       (default 1M; ``BENCH_SMOKE`` shrinks) and eager-push delta
+       slices into one receiver per universe; measured: receiver drain
+       merges/sec, growth events (binned tier promotions vs hash
+       rehashes), and EntriesMsg wire bytes (the dense-extraction win).
+    2. **steady state** — further rounds touch EXISTING keys only; the
+       hash universe must report ZERO growth events (asserted: update
+       churn reuses killed lanes — no tombstones — so no rehash stalls,
+       the ROADMAP claim this backend exists for).
+    3. **fleet** — a fleet of hash members at steady state: batched
+       vmapped dispatches with zero growth events inside the batch
+       (asserted).
+
+    Parity gates (disqualify the speedup if violated): universe reads
+    equal, receiver leaf digests + contexts bit-equal (digest equality
+    ⇒ content equality), sequence numbers equal; plus a shared-sender
+    leg where one binned writer feeds a hash receiver and a binned
+    receiver with WALs — WAL segment BYTES and ack streams must be
+    identical. Host-bound dispatch + transfer shape is the measured
+    effect, so this runs wherever invoked (no device claim dance)."""
+    import tempfile
+
+    from delta_crdt_ex_tpu import AWLWWMap
+    from delta_crdt_ex_tpu.api import start_link
+    from delta_crdt_ex_tpu.runtime import sync as sync_proto, telemetry
+    from delta_crdt_ex_tpu.runtime.clock import LogicalClock
+    from delta_crdt_ex_tpu.runtime.fleet import Fleet
+    from delta_crdt_ex_tpu.runtime.transport import LocalTransport
+
+    n_senders = 4 if SMOKE else 16
+    total_keys = int(
+        os.environ.get("BENCH_HASHSTORE_KEYS", "2048" if SMOKE else "1000000")
+    )
+    steady_rounds = 2 if SMOKE else 5
+    depth = 8 if SMOKE else 12  # receiver sync-index depth
+    per_sender = total_keys // n_senders
+
+    grown: dict[str, int] = {}
+    growth_handler = lambda _e, _m, meta: grown.__setitem__(
+        meta["name"], grown.get(meta["name"], 0) + 1
+    )
+    telemetry.attach(telemetry.CAPACITY_GROWN, growth_handler)
+
+    def mk_universe(store: str):
+        transport = LocalTransport()
+        clock = LogicalClock()
+        mk = lambda name, **kw: start_link(
+            AWLWWMap, threaded=False, transport=transport, clock=clock,
+            capacity=2 * per_sender if "snd" in name else 2 * total_keys,
+            tree_depth=depth, store=store, name=name, **kw,
+        )
+        # pinned node ids: the two universes must mint IDENTICAL dots
+        # (writer gid is part of dot identity and of every entry hash)
+        recv = mk(f"{store}_recv", node_id=4242)
+        senders = [
+            mk(f"{store}_snd{i}", node_id=1000 + i) for i in range(n_senders)
+        ]
+        for s in senders:
+            s.set_neighbours([recv])
+        return transport, senders, recv
+
+    def drain_universe(transport, senders, recv, stats):
+        """Push + drain until quiescent; accumulate time/messages/bytes."""
+        while True:
+            for s in senders:
+                s.sync_to_all()
+            msgs = [
+                m
+                for m in transport.drain(recv.addr)
+                if isinstance(m, sync_proto.EntriesMsg)
+            ]
+            if not msgs:
+                break
+            stats["messages"] += len(msgs)
+            stats["wire_bytes"] += sum(
+                int(v.nbytes)
+                for m in msgs
+                for v in m.arrays.values()
+                if hasattr(v, "nbytes")
+            )
+            for m in msgs:
+                transport.send(recv.addr, m)
+            t0 = time.perf_counter()
+            recv.process_pending()
+            stats["drain_s"] += time.perf_counter() - t0
+            for s in senders:
+                transport.drain(s.addr)  # walk back-traffic: not measured
+
+    results: dict[str, dict] = {}
+    universes: dict[str, tuple] = {}
+    rng = np.random.default_rng(0)
+    key_terms = rng.permutation(np.arange(1, total_keys + 1, dtype=np.int64))
+    for store in ("hash", "binned"):
+        _stage(f"hashstore: building {store} universe ({total_keys} keys)")
+        transport, senders, recv = mk_universe(store)
+        universes[store] = (transport, senders, recv)
+        st = {
+            "messages": 0, "wire_bytes": 0, "drain_s": 0.0,
+            "load_growth": 0, "steady_growth": 0, "steady_messages": 0,
+            "steady_drain_s": 0.0, "steady_wire_bytes": 0,
+        }
+        results[store] = st
+        grown.clear()
+        t_load = time.perf_counter()
+        for i, s in enumerate(senders):
+            shard = key_terms[i * per_sender : (i + 1) * per_sender]
+            s.mutate_batch("add", [[int(k), int(k)] for k in shard])
+        drain_universe(transport, senders, recv, st)
+        st["load_wall_s"] = round(time.perf_counter() - t_load, 3)
+        st["load_growth"] = sum(grown.values())
+        # steady state: same keys, fresh values — no growth expected
+        grown.clear()
+        steady = {
+            "messages": 0, "wire_bytes": 0, "drain_s": 0.0,
+        }
+        for rnd in range(steady_rounds):
+            for i, s in enumerate(senders):
+                shard = key_terms[i * per_sender : i * per_sender + 64]
+                s.mutate_batch("add", [[int(k), int(k) + rnd + 1] for k in shard])
+            drain_universe(transport, senders, recv, steady)
+        st["steady_messages"] = steady["messages"]
+        st["steady_drain_s"] = round(steady["drain_s"], 4)
+        st["steady_wire_bytes"] = steady["wire_bytes"]
+        st["steady_growth"] = sum(grown.values())
+        st["drain_s"] = round(st["drain_s"], 4)
+        st["merges_per_sec"] = round(st["messages"] / st["drain_s"], 2) if st["drain_s"] else 0.0
+        st["steady_merges_per_sec"] = (
+            round(st["steady_messages"] / st["steady_drain_s"], 2)
+            if st["steady_drain_s"]
+            else 0.0
+        )
+        log(
+            f"hashstore[{store}]: load {st['messages']} msgs @ "
+            f"{st['merges_per_sec']} merges/s, growth {st['load_growth']}; "
+            f"steady {st['steady_merges_per_sec']} merges/s, growth "
+            f"{st['steady_growth']}; wire {st['wire_bytes']} B"
+        )
+
+    # the phase-2 gate: steady-state churn must not grow the hash table
+    assert results["hash"]["steady_growth"] == 0, (
+        f"hash store grew {results['hash']['steady_growth']}x at steady state"
+    )
+
+    # ---- parity gate 1: symmetric universes agree exactly -------------
+    _stage("hashstore: parity gate (reads + canonical state + seq)")
+    h_recv, b_recv = universes["hash"][2], universes["binned"][2]
+    assert h_recv.read() == b_recv.read(), "hash/binned reads diverged"
+    assert h_recv._seq == b_recv._seq
+    for col in ("leaf", "ctx_gid", "ctx_max"):
+        assert np.array_equal(
+            np.asarray(getattr(h_recv.state, col)),
+            np.asarray(getattr(b_recv.state, col)),
+        ), f"hash/binned receiver state diverged: {col}"
+
+    # ---- parity gate 2: shared writer, WAL bytes + ack streams --------
+    _stage("hashstore: parity gate (WAL bytes + acks, shared writer)")
+    with tempfile.TemporaryDirectory() as tmp:
+        transport = LocalTransport()
+        clock = LogicalClock()
+        wmk = lambda name, store, **kw: start_link(
+            AWLWWMap, threaded=False, transport=transport, clock=clock,
+            capacity=1024, tree_depth=8, store=store, name=name, **kw,
+        )
+        writer = wmk("par_w", "binned")
+        rcv = {
+            store: wmk(
+                f"par_{store}", store, node_id=777,
+                wal_dir=os.path.join(tmp, store), fsync_mode="none",
+            )
+            for store in ("hash", "binned")
+        }
+        writer.set_neighbours(list(rcv.values()))
+        script = np.random.default_rng(7)
+        for _ in range(4):
+            for _ in range(24):
+                k = int(script.integers(0, 64))
+                if script.random() < 0.75:
+                    writer.mutate("add", [k, int(script.integers(0, 99))])
+                else:
+                    writer.mutate("remove", [k])
+            writer.sync_to_all()
+            for r in rcv.values():
+                r.process_pending()
+            back = transport.drain(writer.addr)
+            norm = lambda m: (
+                type(m).__name__,
+                getattr(m, "level", None),
+                [b.tolist() for b in getattr(m, "blocks", [])] or None,
+            )
+            acks_h = [norm(m) for m in back if getattr(m, "frm", getattr(m, "clear_addr", None)) == rcv["hash"].addr]
+            acks_b = [norm(m) for m in back if getattr(m, "frm", getattr(m, "clear_addr", None)) == rcv["binned"].addr]
+            assert acks_h == acks_b, "hash/binned reply streams diverged"
+            for m in back:
+                writer.handle(m)
+            for r in rcv.values():
+                r.process_pending()
+        assert rcv["hash"].read() == rcv["binned"].read()
+
+        def wal_bytes(rep):
+            out = b""
+            for p in sorted(rep._wal.segment_paths()):
+                with open(p, "rb") as f:
+                    out += f.read()
+            return out
+
+        assert wal_bytes(rcv["hash"]) == wal_bytes(rcv["binned"]) != b"", (
+            "hash/binned WAL bytes diverged"
+        )
+
+    # ---- phase 3: hash fleet at steady state --------------------------
+    _stage("hashstore: fleet steady-state phase")
+    fleet_n = 4 if SMOKE else 8
+    transport = LocalTransport()
+    clock = LogicalClock()
+    fmk = lambda name, **kw: start_link(
+        AWLWWMap, threaded=False, transport=transport, clock=clock,
+        capacity=4096, tree_depth=8, store="hash", name=name, **kw,
+    )
+    members = [fmk(f"flt_m{i}") for i in range(fleet_n)]
+    fsenders = [fmk(f"flt_s{i}") for i in range(fleet_n)]
+    fleet = Fleet(members)
+    for i, s in enumerate(fsenders):
+        s.set_neighbours([members[i]])
+        s.mutate_batch("add", [[j, j] for j in range(256)])  # warm capacity
+        s.sync_to_all()
+    for r in members:
+        msgs = [m for m in transport.drain(r.addr) if isinstance(m, sync_proto.EntriesMsg)]
+        for m in msgs:
+            transport.send(r.addr, m)
+    fleet.drain()
+    grown.clear()
+    for rnd in range(steady_rounds):
+        for s in fsenders:
+            s.mutate_batch("add", [[j, j + rnd + 1] for j in range(64)])
+            s.sync_to_all()
+        for r in members:
+            msgs = [m for m in transport.drain(r.addr) if isinstance(m, sync_proto.EntriesMsg)]
+            for m in msgs:
+                transport.send(r.addr, m)
+        fleet.drain()
+        for s in fsenders:
+            transport.drain(s.addr)
+    fleet_growth = sum(grown.get(m.name, 0) for m in members)
+    fstats = fleet.stats()
+    assert fleet_growth == 0, "hash fleet member grew mid-batch at steady state"
+    assert fstats["dispatches"] >= 1, "hash fleet never batched"
+    for i, m in enumerate(members):
+        assert len(m.read()) == 256, i
+    telemetry.detach(telemetry.CAPACITY_GROWN, growth_handler)
+    log(
+        f"hashstore[fleet]: {fstats['dispatches']} batched dispatches, "
+        f"occupancy {fstats['avg_occupancy']}, growth {fleet_growth}"
+    )
+
+    h, b = results["hash"], results["binned"]
+    _emit({
+        "metric": "hashstore_ingest_merges_per_sec" + ("_smoke" if SMOKE else ""),
+        "unit": "merges/sec",
+        "stat": "aggregate_load_drain",
+        "value": h["merges_per_sec"],
+        "keys": total_keys,
+        "senders": n_senders,
+        "tree_depth": depth,
+        "hash": h,
+        "binned": b,
+        "ingest_ratio_hash_vs_binned": (
+            round(h["merges_per_sec"] / b["merges_per_sec"], 3)
+            if b["merges_per_sec"]
+            else 0.0
+        ),
+        "wire_bytes_ratio_hash_vs_binned": (
+            round(h["wire_bytes"] / b["wire_bytes"], 4) if b["wire_bytes"] else 0.0
+        ),
+        "growth_events": {
+            "hash_load": h["load_growth"],
+            "binned_load": b["load_growth"],
+            "hash_steady": h["steady_growth"],
+            "binned_steady": b["steady_growth"],
+            "hash_fleet_steady": fleet_growth,
+        },
+        "fleet": {
+            "members": fleet_n,
+            "dispatches": fstats["dispatches"],
+            "avg_occupancy": fstats["avg_occupancy"],
+            "fallbacks": fstats["fallbacks"],
+        },
+        "parity": "reads+leaf+ctx+seq (symmetric) and wal_bytes+acks (shared writer), asserted in-run",
         "backend": "cpu",
     })
 
@@ -1392,6 +1709,9 @@ def main():
         return
     if "--fleet" in sys.argv:
         bench_fleet()
+        return
+    if "--hashstore" in sys.argv:
+        bench_hashstore()
         return
     if "--tpu-child" in sys.argv:
         # SIGTERM → clean Python unwind (finalizers run, the device

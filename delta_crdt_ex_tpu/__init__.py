@@ -40,6 +40,8 @@ __all__ = [
     "AWLWWMap",
     "AWSet",
     "DeltaCrdt",
+    "HashAWLWWMap",
+    "HashAWSet",
     "FileStorage",
     "Fleet",
     "MemoryStorage",
@@ -65,6 +67,8 @@ _EXPORTS = {
     "AWLWWMap": ("delta_crdt_ex_tpu.models.binned_map", "BinnedAWLWWMap"),
     "AWSet": ("delta_crdt_ex_tpu.models.binned_map", "AWSet"),
     "DeltaCrdt": ("delta_crdt_ex_tpu.api", "DeltaCrdt"),
+    "HashAWLWWMap": ("delta_crdt_ex_tpu.models.hash_store", "HashAWLWWMap"),
+    "HashAWSet": ("delta_crdt_ex_tpu.models.hash_store", "HashAWSet"),
     "Fleet": ("delta_crdt_ex_tpu.runtime.fleet", "Fleet"),
     "MemoryStorage": ("delta_crdt_ex_tpu.runtime.storage", "MemoryStorage"),
     "FileStorage": ("delta_crdt_ex_tpu.runtime.storage", "FileStorage"),

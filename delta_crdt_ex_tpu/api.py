@@ -40,14 +40,63 @@ from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap as AWLWWMap
 from delta_crdt_ex_tpu.runtime.fleet import Fleet
 from delta_crdt_ex_tpu.runtime.replica import Replica
 
+
+def _resolve_store(crdt_module, store: "str | None"):
+    """Map a model class onto the requested dot-store backend
+    (ISSUE 8): ``store="hash"`` selects the open-addressing hash-table
+    store, ``store="binned"`` the bucket-binned rows (the default).
+    Explicit hash model classes pass through unchanged."""
+    if store is None:
+        return crdt_module
+    if store not in ("hash", "binned"):
+        raise ValueError(f"unknown store backend {store!r}; use 'hash' or 'binned'")
+    backend = getattr(crdt_module, "backend", None)
+    if backend == store:
+        return crdt_module
+    from delta_crdt_ex_tpu.models.binned_map import AWSet, BinnedAWLWWMap
+    from delta_crdt_ex_tpu.models.hash_store import HashAWSet, HashAWLWWMap
+
+    mapping = {
+        ("hash", BinnedAWLWWMap): HashAWLWWMap,
+        ("hash", AWSet): HashAWSet,
+        ("binned", HashAWLWWMap): BinnedAWLWWMap,
+        ("binned", HashAWSet): AWSet,
+    }
+    try:
+        return mapping[(store, crdt_module)]
+    except KeyError:
+        raise ValueError(
+            f"{crdt_module!r} has no {store!r}-store counterpart; pass a "
+            "model class whose backend matches, or omit store="
+        ) from None
+
 DEFAULT_SYNC_INTERVAL = 0.2  # seconds (reference: 200 ms, delta_crdt.ex:31)
 DEFAULT_MAX_SYNC_SIZE = 200  # items (reference: delta_crdt.ex:32)
 
 DeltaCrdt = Replica  # the handle type users hold
 
 
-def start_link(crdt_module=AWLWWMap, *, threaded: bool = True, **opts) -> Replica:
+def start_link(
+    crdt_module=AWLWWMap,
+    *,
+    threaded: bool = True,
+    store: "str | None" = None,
+    **opts,
+) -> Replica:
     """Start a replica (reference ``DeltaCrdt.start_link/2``).
+
+    ``store`` selects the dot-store backend (ISSUE 8): ``"binned"``
+    (default) is the bucket-binned row engine; ``"hash"`` is the
+    device-resident open-addressing hash table — O(1) point upserts
+    that reuse killed lanes (steady-state churn never grows the
+    table), dense non-padded wire extraction, and a
+    window-pressure-advised ×2 rehash as its only growth event (no
+    per-tier repacking; fleet batches survive growth, with the
+    advisory growing pressured members off the batch path). The two
+    backends are protocol-identical
+    and bit-for-bit parity-gated (``tests/test_hash_store.py``,
+    ``bench.py --hashstore``); snapshots/WALs record their backend, and
+    cross-backend restore goes through extraction (MIGRATING.md).
 
     ``threaded=True`` runs the periodic anti-entropy loop in a background
     thread (the GenServer-process analog). ``threaded=False`` leaves
@@ -100,7 +149,7 @@ def start_link(crdt_module=AWLWWMap, *, threaded: bool = True, **opts) -> Replic
     """
     opts.setdefault("sync_interval", DEFAULT_SYNC_INTERVAL)
     opts.setdefault("max_sync_size", DEFAULT_MAX_SYNC_SIZE)
-    replica = Replica(crdt_module, **opts)
+    replica = Replica(_resolve_store(crdt_module, store), **opts)
     if threaded:
         replica.start()
     return replica
@@ -113,6 +162,7 @@ def start_fleet(
     threaded: bool = True,
     names: "list | None" = None,
     min_batch: int = 2,
+    store: "str | None" = None,
     **opts,
 ) -> Fleet:
     """Start ``n`` replicas served by ONE batched event loop (ISSUE 6:
@@ -143,6 +193,7 @@ def start_fleet(
         raise ValueError(f"{len(names)} names for {n} replicas")
     opts.setdefault("sync_interval", DEFAULT_SYNC_INTERVAL)
     opts.setdefault("max_sync_size", DEFAULT_MAX_SYNC_SIZE)
+    crdt_module = _resolve_store(crdt_module, store)
     replicas = []
     for i in range(n):
         member = dict(opts)

@@ -10,7 +10,16 @@ kernel chain.
 
 from delta_crdt_ex_tpu.models.binned import BinnedStore
 
-__all__ = ["AWLWWMap", "BinnedAWLWWMap", "BinnedStore", "DotStore", "FlatAWLWWMap"]
+__all__ = [
+    "AWLWWMap",
+    "BinnedAWLWWMap",
+    "BinnedStore",
+    "DotStore",
+    "FlatAWLWWMap",
+    "HashAWLWWMap",
+    "HashAWSet",
+    "HashStore",
+]
 
 # All model classes resolve lazily: ``binned_map`` imports ``ops.binned``
 # which imports ``models.binned`` — an eager import here would re-enter
@@ -21,6 +30,9 @@ _LAZY = {
     "AWLWWMap": ("delta_crdt_ex_tpu.models.binned_map", "BinnedAWLWWMap"),
     "FlatAWLWWMap": ("delta_crdt_ex_tpu.models.aw_lww_map", "AWLWWMap"),
     "DotStore": ("delta_crdt_ex_tpu.models.state", "DotStore"),
+    "HashAWLWWMap": ("delta_crdt_ex_tpu.models.hash_store", "HashAWLWWMap"),
+    "HashAWSet": ("delta_crdt_ex_tpu.models.hash_store", "HashAWSet"),
+    "HashStore": ("delta_crdt_ex_tpu.models.hash_store", "HashStore"),
 }
 
 

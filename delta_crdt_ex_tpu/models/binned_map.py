@@ -501,6 +501,65 @@ class BinnedAWLWWMap:
         this (e.g. :class:`AWSet` below)."""
         return d
 
+    # -- replica/fleet backend seam (ISSUE 8): each dot-store backend
+    # declares its own growth escape and batch-compatibility key instead
+    # of the runtime hardcoding binned geometry
+
+    #: snapshot backend tag (recorded in snapshots; cross-backend
+    #: restore must go through extraction — MIGRATING.md)
+    backend = "binned"
+    #: static (non-array) Store fields — none for this backend
+    STORE_META = ()
+
+    @staticmethod
+    def grow_for_apply(state: BinnedStore) -> BinnedStore:
+        """Local-mutation overflow escape: bin tier ×2."""
+        return state.grow(bin_capacity=state.bin_capacity * 2)
+
+    @staticmethod
+    def post_apply(state: BinnedStore, res, on_grow=None) -> BinnedStore:
+        """Post-commit hook (no load advisory for the binned store)."""
+        return state
+
+    @staticmethod
+    def load_high(max_window_fill: int, probe_window: int) -> bool:
+        """No fleet growth advisory: bin growth happens via the
+        per-merge ``need_fill_grow`` escape only."""
+        return False
+
+    @staticmethod
+    def store_load_high(state: BinnedStore) -> bool:
+        return False
+
+    @staticmethod
+    def geometry(state: BinnedStore) -> tuple:
+        """Batch-compatibility key: fleet batch buckets require equal
+        state geometry — for this backend the per-bucket lane tier B,
+        which is why binned fleets split batches at tier boundaries."""
+        return (
+            "binned",
+            state.num_buckets,
+            state.bin_capacity,
+            state.replica_capacity,
+        )
+
+    @staticmethod
+    def geometry_stacked(stacked) -> tuple:
+        """Same key read from a fleet-stacked pytree's shapes (must not
+        materialise a lane)."""
+        return (
+            "binned",
+            stacked.key.shape[1],
+            stacked.key.shape[2],
+            stacked.ctx_gid.shape[1],
+        )
+
+    @classmethod
+    def fleet_merge_rows(cls, states, slices):
+        from delta_crdt_ex_tpu.runtime import transition
+
+        return transition.jit_fleet_merge_rows(states, slices)
+
 
 class AWSet(BinnedAWLWWMap):
     """Add-wins observed-remove set — the second δ-CRDT of the reference

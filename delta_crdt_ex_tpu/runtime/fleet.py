@@ -270,8 +270,20 @@ class Fleet:
         sl, real_rows = stack_entry_slices([st.sl for st in members], lanes=lanes)
         reps = [st.rep for st in members]
         stacked_in, cache_key, _versions = self._stacked_states(reps, lanes)
-        res = transition.jit_fleet_merge_rows(stacked_in, sl)
-        ok, n_killed = jax.device_get((res.ok, res.n_killed))
+        # backend-owned batched dispatch (ISSUE 8): the bucket key is the
+        # model's batch-compatibility key (backend tag included), so all
+        # members of a bucket share one store backend and its vmapped
+        # merge form — binned buckets split at lane-tier boundaries,
+        # hash buckets only at a table rehash
+        res = reps[0].model.fleet_merge_rows(stacked_in, sl)
+        # hash backend: per-lane window pressure rides the same readback
+        # so the growth advisory below costs no extra device sync
+        wfill = getattr(res, "max_window_fill", None)
+        if wfill is not None:
+            ok, n_killed, wfill = jax.device_get((res.ok, res.n_killed, wfill))
+        else:
+            ok, n_killed = jax.device_get((res.ok, res.n_killed))
+        probe_window = getattr(stacked_in, "probe_window", 0)
         dt = time.perf_counter() - t0
         # per-row count readback is lazy and shared: one device_get for
         # the whole stack, paid only if any SYNC_DONE handler exists
@@ -311,6 +323,17 @@ class Fleet:
             if new_version is not None:
                 committed += 1
                 committed_versions.append(new_version)
+                if wfill is not None and st.rep.model.load_high(
+                    int(wfill[lane]), probe_window
+                ):
+                    # grow OFF the batch path (ISSUE 8): a lane whose
+                    # hot probe window nears overflow would otherwise
+                    # keep batching until it overflows and escapes
+                    # mid-batch. The version bump drops it from the
+                    # resident stack; it re-buckets at its new capacity
+                    # next tick.
+                    st.rep.grow_store_advised()
+                    all_committed = False
             else:
                 # the member mutated between staging and commit: the
                 # batched merge read a stale state — replay solo

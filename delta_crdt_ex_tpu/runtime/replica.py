@@ -67,7 +67,6 @@ from delta_crdt_ex_tpu.runtime.wal import ReplayClock, WalLog
 
 logger = logging.getLogger("delta_crdt_ex_tpu")
 
-_COLUMNS = tuple(f.name for f in dataclasses.fields(BinnedStore))
 _SLICE_COLUMNS = ("key", "valh", "ts", "node", "ctr", "alive")
 
 
@@ -328,10 +327,17 @@ class Replica:
         #: classic walk until our watermark passes it, or every round
         #: would re-request the same unservable range
         self._catchup_walk_floor: dict[Any, int] = {}
-        #: catch-up observability (stats() + telemetry)
+        #: catch-up observability (stats() + telemetry). Lane/entry
+        #: counts quantify the transfer-padding overhead per store
+        #: backend (ISSUE 8 satellite: the PR 4 "chunk bytes ~2× the
+        #: walk's" finding is padding — the binned store ships whole
+        #: bin-tier rows, the hash store ships dense content-sized
+        #: slices; chunk_fill_ratio makes the difference observable).
         self._catchup_chunks_served = 0
         self._catchup_chunks_applied = 0
         self._catchup_bytes_shipped = 0
+        self._catchup_lanes_shipped = 0
+        self._catchup_entries_shipped = 0
         self._catchup_rows_applied = 0
         self._catchup_horizon_fallbacks = 0
         self._catchup_last_duration = 0.0
@@ -443,19 +449,29 @@ class Replica:
             self._fleet_src = None
             self._state_version += 1
 
-    def _geometry(self) -> tuple:
-        """``(num_buckets, bin_capacity, replica_capacity)`` without
-        forcing a fleet-held lane to materialise (the fleet's shape
-        bucketing must stay free of device work)."""
-        if self._state is not None:
-            st = self._state
-            return (st.num_buckets, st.bin_capacity, st.replica_capacity)
-        stacked, _lane = self._fleet_src
-        return (
-            stacked.key.shape[1],
-            stacked.key.shape[2],
-            stacked.ctx_gid.shape[1],
+    def _store_columns(self) -> tuple:
+        """Snapshot ARRAY column set of this replica's store backend
+        (static metadata fields — e.g. the hash store's probe window —
+        snapshot as plain ints, see ``_store_meta``)."""
+        meta = self._store_meta()
+        return tuple(
+            f.name
+            for f in dataclasses.fields(self.model.Store)
+            if f.name not in meta
         )
+
+    def _store_meta(self) -> tuple:
+        return getattr(self.model, "STORE_META", ())
+
+    def _geometry(self) -> tuple:
+        """The model's batch-compatibility key (backend tag + state
+        geometry — each backend declares its own, ISSUE 8 satellite)
+        without forcing a fleet-held lane to materialise (the fleet's
+        shape bucketing must stay free of device work)."""
+        if self._state is not None:
+            return self.model.geometry(self._state)
+        stacked, _lane = self._fleet_src
+        return self.model.geometry_stacked(stacked)
 
     def _warmup(self) -> None:
         """Pre-trigger the jit compile of the single-op mutate tier so the
@@ -492,9 +508,24 @@ class Replica:
         require_layout(
             snap.__dict__.get("layout", "<untagged>"), f"snapshot for {self.name!r}"
         )
+        # snapshots record their store backend (ISSUE 8): arrays of one
+        # layout cannot rehydrate the other — cross-backend migration
+        # goes through extraction (MIGRATING.md), never a cast
+        snap_store = snap.__dict__.get("store", "binned")
+        if snap_store != self.model.backend:
+            raise ValueError(
+                f"snapshot for {self.name!r} was written by the "
+                f"{snap_store!r} dot store but this replica runs "
+                f"{self.model.backend!r} — cross-backend restore goes "
+                "through extraction (see MIGRATING.md), or delete the "
+                "stored snapshot to start fresh"
+            )
         self.node_id = snap.node_id
         self._seq = snap.sequence_number
-        self.state = BinnedStore(**{c: jnp.asarray(snap.arrays[c]) for c in _COLUMNS})
+        self.state = self.model.Store(
+            **{c: jnp.asarray(snap.arrays[c]) for c in self._store_columns()},
+            **{m: int(snap.arrays[m]) for m in self._store_meta()},
+        )
         gids = snap.arrays["ctx_gid"]
         slots = np.nonzero(gids == np.uint64(self.node_id))[0]
         assert len(slots) == 1, "rehydrated state must contain our node id"
@@ -513,14 +544,19 @@ class Replica:
         self._read_cache_kh = None
 
     def _snapshot(self) -> Snapshot:
+        state = self.state
+        arrays = {c: np.asarray(getattr(state, c)) for c in self._store_columns()}
+        for m in self._store_meta():
+            arrays[m] = int(getattr(state, m))
         return Snapshot(
             node_id=self.node_id,
             sequence_number=self._seq,
-            arrays={c: np.asarray(getattr(self.state, c)) for c in _COLUMNS},
+            arrays=arrays,
             payloads=dict(self._payloads),
             key_terms=dict(self._key_terms),
             last_ts=self.clock._last,
             peer_seqs=dict(self._applied_seq),
+            store=self.model.backend,
         )
 
     def _persist(self) -> None:
@@ -1097,7 +1133,11 @@ class Replica:
                 *map(jnp.asarray, (g.rows, g.op, g.key, g.valh, g.ts)),
             )
             if bool(res.ok):
-                self.state = res.state
+                # post_apply is the backend's load advisory (the hash
+                # store's load-factor rehash rides the result's counts)
+                self.state = self.model.post_apply(
+                    res.state, res, on_grow=self._grown_telemetry
+                )
                 break
             self._grow_bin()
         self._own_ctr_cache = None  # fresh own dots: push cursors lag
@@ -1124,8 +1164,29 @@ class Replica:
         self._touch_seq += k
 
     def _grow_bin(self) -> None:
-        self.state = self.state.grow(bin_capacity=self.state.bin_capacity * 2)
+        # backend-owned overflow escape: bin tier ×2 (binned) or a
+        # whole-table rehash (hash — THE growth event, ISSUE 8)
+        self.state = self.model.grow_for_apply(self.state)
         self._grown_telemetry(self.state)
+
+    def grow_store_advised(self) -> None:
+        """Fleet post-commit growth advisory (ISSUE 8): the vmapped
+        merge reported this member's hot probe window near overflow, so
+        grow the store off the batch path before it overflows and
+        escapes mid-batch. Re-checks under the lock — a concurrent
+        mutate may already have grown the table between the fleet's
+        readback and here — and commits through the state internals
+        rather than the self-locking property setter, so the whole
+        check-then-grow is ONE critical section the lock analysis can
+        see (the property's per-access locks would not make the
+        read-modify-write atomic on their own)."""
+        with self._lock:
+            st = self.state
+            if self.model.store_load_high(st):
+                self._state = self.model.grow_for_apply(st)
+                self._fleet_src = None
+                self._state_version += 1
+                self._grown_telemetry(self._state)
 
     def _grown_telemetry(self, state) -> None:
         telemetry.execute(
@@ -2082,6 +2143,14 @@ class Replica:
             )
             self._catchup_chunks_served += 1
             self._catchup_bytes_shipped += n_bytes
+            # per-store padding accounting: shipped entry lanes vs alive
+            # entries (payload count == alive dots by construction)
+            self._catchup_lanes_shipped += sum(
+                int(s["arrays"]["key"].size) for s in slices
+            )
+            self._catchup_entries_shipped += sum(
+                len(s["payloads"]) for s in slices
+            )
             if telemetry.has_handlers(telemetry.CATCHUP_CHUNK):
                 telemetry.execute(
                     telemetry.CATCHUP_CHUNK,
@@ -2571,14 +2640,20 @@ class Replica:
         ``_payloads``/``_key_terms`` proportional to live entries
         (VERDICT r2 weak #3) at amortized O(1) per op."""
         with self._lock:
+            # store-layout-agnostic (ISSUE 8): a dot's bucket is a pure
+            # function of its key, so derive it instead of reading the
+            # binned row index — the same pass serves the [L, B] rows
+            # and the flat hash table
             alive = np.asarray(self.state.alive)
-            u_idx, b_idx = np.nonzero(alive)
-            node_sel = np.asarray(self.state.node)[u_idx, b_idx]
+            idx = np.nonzero(alive)
+            node_sel = np.asarray(self.state.node)[idx]
             gid_l = np.asarray(self.state.ctx_gid)[node_sel].tolist()
-            ctr_l = np.asarray(self.state.ctr)[u_idx, b_idx].tolist()
-            live = set(zip(gid_l, u_idx.tolist(), ctr_l))
+            ctr_l = np.asarray(self.state.ctr)[idx].tolist()
+            keys = np.asarray(self.state.key)[idx]
+            bucket = (keys & np.uint64(self.num_buckets - 1)).astype(np.int64)
+            live = set(zip(gid_l, bucket.tolist(), ctr_l))
             self._payloads = {d: p for d, p in self._payloads.items() if d in live}
-            keep_keys = set(np.asarray(self.state.key)[u_idx, b_idx].tolist())
+            keep_keys = set(keys.tolist())
             self._key_terms = {h: t for h, t in self._key_terms.items() if h in keep_keys}
             self._gc_pressure = 0
             self._gc_floor = len(self._payloads)
@@ -2711,10 +2786,24 @@ class Replica:
                     "fallbacks": self._fleet_fallbacks,
                 },
                 "catchup": {
+                    "store": self.model.backend,
                     "chunks_served": self._catchup_chunks_served,
                     "chunks_applied": self._catchup_chunks_applied,
                     "rows_applied": self._catchup_rows_applied,
                     "bytes_shipped": self._catchup_bytes_shipped,
+                    "lanes_shipped": self._catchup_lanes_shipped,
+                    "entries_shipped": self._catchup_entries_shipped,
+                    # alive entries per shipped lane: 1.0 = dense (the
+                    # hash store's extraction), low = bin-tier padding
+                    "chunk_fill_ratio": (
+                        round(
+                            self._catchup_entries_shipped
+                            / self._catchup_lanes_shipped,
+                            4,
+                        )
+                        if self._catchup_lanes_shipped
+                        else 0.0
+                    ),
                     "horizon_fallbacks": self._catchup_horizon_fallbacks,
                     "in_flight": len(self._catchup),
                     "last_duration_s": round(self._catchup_last_duration, 6),

@@ -50,6 +50,11 @@ class Snapshot:
     #: (read via ``__dict__.get``): catch-up then starts from 0, which
     #: only over-serves (merges are idempotent).
     peer_seqs: dict | None = None
+    #: dot-store backend that wrote ``arrays`` (ISSUE 8: "binned" — the
+    #: legacy default for untagged pickles — or "hash"). Rehydrate
+    #: rejects a mismatch: the layouts share no array shapes, so
+    #: cross-backend restore goes through extraction (MIGRATING.md).
+    store: str = "binned"
 
 
 def require_layout(tag, what: str) -> None:

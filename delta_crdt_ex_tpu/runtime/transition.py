@@ -31,7 +31,9 @@ from __future__ import annotations
 import jax
 
 from delta_crdt_ex_tpu.models.binned import BinnedStore
+from delta_crdt_ex_tpu.models.hash_store import HashStore
 from delta_crdt_ex_tpu.ops import binned as binned_ops
+from delta_crdt_ex_tpu.ops import hash_map as hash_ops
 
 # ---------------------------------------------------------------------------
 # single-replica transitions (the replica loop's device calls, re-exported
@@ -92,6 +94,37 @@ jit_fleet_row_apply = jax.jit(fleet_row_apply)
 jit_fleet_extract_rows = jax.jit(fleet_extract_rows)
 jit_fleet_compact_rows = jax.jit(fleet_compact_rows)
 jit_fleet_winner_all = jax.jit(fleet_winner_all)
+
+
+# ---------------------------------------------------------------------------
+# hash-store fleet transitions (ISSUE 8): the same leading-replica-axis
+# shape over the open-addressing backend — hash-store fleet members
+# bucket by TABLE CAPACITY (which moves only on a rehash) instead of
+# the binned per-bucket lane tier, so batches survive growth
+
+
+def fleet_hash_merge_rows(states: HashStore, slices) -> hash_ops.HashMergeResult:
+    """Batched anti-entropy merge over stacked hash-store states: lane k
+    joins ``slices`` lane k via
+    :func:`~delta_crdt_ex_tpu.ops.hash_map.merge_rows`. Per-lane
+    ``ok``/``need_*``/``gap_row`` escapes route through the solo
+    growth/repair paths exactly like the binned fleet form."""
+    return jax.vmap(hash_ops.merge_rows)(states, slices)
+
+
+def fleet_hash_row_apply(states, self_slots, rows, op, key, valh, ts):
+    """Batched local mutation over stacked hash-store states."""
+    return jax.vmap(hash_ops.row_apply)(states, self_slots, rows, op, key, valh, ts)
+
+
+def fleet_hash_winner_all(states: HashStore):
+    """Batched whole-table LWW winner resolution, hash backend."""
+    return jax.vmap(hash_ops.winner_all)(states)
+
+
+jit_fleet_hash_merge_rows = jax.jit(fleet_hash_merge_rows)
+jit_fleet_hash_row_apply = jax.jit(fleet_hash_row_apply)
+jit_fleet_hash_winner_all = jax.jit(fleet_hash_winner_all)
 
 
 # ---------------------------------------------------------------------------

@@ -85,18 +85,26 @@ class KernelMap:
 
 
 class BinnedKernelMap:
-    """Same harness over the bucket-binned engine (models/binned.py)."""
+    """Same harness over the bucket-binned engine (models/binned.py).
+    All backend differences ride the model seam (``grow_for_apply`` /
+    ``post_apply`` / the shared wire-slice shape), so
+    :class:`HashKernelMap` below is this class with a different model
+    resolved — one drive implementation serves both parity sides."""
 
-    def __init__(self, gid: int, capacity: int = 64, rcap: int = 8, num_buckets: int = 64):
+    @staticmethod
+    def _resolve():
         from delta_crdt_ex_tpu.models.binned import BinnedStore
         from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap
 
-        self.M = BinnedAWLWWMap
+        return BinnedAWLWWMap, BinnedStore
+
+    def __init__(self, gid: int, capacity: int = 64, rcap: int = 8, num_buckets: int = 64):
+        self.M, store_cls = self._resolve()
         self.gid = gid
         bin_cap = 4
         while bin_cap * num_buckets < capacity:  # power-of-two tier
             bin_cap *= 2
-        state = BinnedStore.new(num_buckets, bin_cap, rcap)
+        state = store_cls.new(num_buckets, bin_cap, rcap)
         self.state = dataclasses.replace(
             state, ctx_gid=state.ctx_gid.at[0].set(jnp.uint64(gid))
         )
@@ -131,9 +139,9 @@ class BinnedKernelMap:
                 *map(jnp.asarray, (g.rows, g.op, g.key, g.valh, g.ts)),
             )
             if bool(res.ok):
-                self.state = res.state
+                self.state = self.M.post_apply(res.state, res)
                 return res
-            self.state = self.state.grow(bin_capacity=self.state.bin_capacity * 2)
+            self.state = self.M.grow_for_apply(self.state)
 
     def add(self, key: int, val: int, ts: int):
         return self._apply([(OP_ADD, key, val, ts)])
@@ -147,9 +155,11 @@ class BinnedKernelMap:
     def batch(self, rows):
         return self._apply(rows)
 
-    def join_from(self, other: "BinnedKernelMap"):
+    def join_from(self, other):
+        # extraction runs on the SOURCE's model: either backend's slice
+        # merges here (the wire slice shape is shared, ISSUE 8)
         rows = np.arange(other.state.num_buckets, dtype=np.int32)
-        sl = self.M.extract_rows(other.state, jnp.asarray(rows))
+        sl = other.M.extract_rows(other.state, jnp.asarray(rows))
         return self.merge_slice(sl)
 
     def merge_slice(self, sl):
@@ -169,6 +179,32 @@ class BinnedKernelMap:
 
     def alive_count(self) -> int:
         return int(self.state.num_alive())
+
+
+class HashKernelMap(BinnedKernelMap):
+    """The open-addressing hash engine (ISSUE 8, models/hash_store.py)
+    through the same drive: only the resolved model and the read differ
+    — everything else rides the backend seam the base class uses."""
+
+    @staticmethod
+    def _resolve():
+        from delta_crdt_ex_tpu.models.hash_store import HashAWLWWMap, HashStore
+
+        return HashAWLWWMap, HashStore
+
+    def read(self) -> dict[int, int]:
+        return read_hash_state(self.state)
+
+
+def read_hash_state(state) -> dict[int, int]:
+    """{key: valh} LWW read of a HashStore (shared by harnesses/tests)."""
+    from delta_crdt_ex_tpu.models.hash_store import HashAWLWWMap
+
+    w = HashAWLWWMap.winner_all(state)
+    win = np.asarray(w.win)
+    keys = np.asarray(w.key)[win]
+    vals = np.asarray(w.valh)[win]
+    return {int(k): int(v) for k, v in zip(keys, vals)}
 
 
 def read_binned_state(state) -> dict[int, int]:

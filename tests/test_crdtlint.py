@@ -1702,6 +1702,73 @@ def test_mutation_impure_fleet_transition_is_caught():
     assert any(f.rule == "PURE003" for f in new)
 
 
+def test_mutation_host_sync_in_hash_kernel_is_caught():
+    """Acceptance (ISSUE 8): an injected ``.item()`` in the hash-store
+    kernel module turns the gate red (SYNC001) — ``ops/hash_map.py`` is
+    a jit-entry-root module by contract like ``runtime/transition.py``,
+    so the leak is caught with no caller jit-wrapping the function."""
+    rel = f"{PKG}/ops/hash_map.py"
+    anchor = "    v = _slice_view(state, sl)"
+    assert anchor in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(
+            anchor, "    _n = state.alive.sum().item()\n" + anchor, 1
+        ),
+    )
+    assert any(
+        f.rule == "SYNC001" and f.path.endswith("ops/hash_map.py") for f in new
+    )
+
+
+def test_mutation_impure_rehash_is_caught():
+    """Acceptance (ISSUE 8): an impure rehash turns the gate red —
+    every function in ``ops/hash_map.py`` is purity-scoped whatever its
+    name (rehash rebuilds anti-entropy state that must replicate
+    bit-for-bit), so an in-place argument mutation (PURE001) and a
+    clock read (PURE003) are both caught."""
+    rel = f"{PKG}/ops/hash_map.py"
+    anchor = "    H_old = state.table_size"
+    assert anchor in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(anchor, "    state.arr = state.ctr\n" + anchor, 1),
+    )
+    assert any(
+        f.rule == "PURE001" and f.path.endswith("ops/hash_map.py") for f in new
+    )
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(anchor, "    _t = time.time()\n" + anchor, 1),
+    )
+    assert any(
+        f.rule == "PURE003" and f.path.endswith("ops/hash_map.py") for f in new
+    )
+
+
+def test_mutation_impure_class_method_in_hash_kernel_is_caught():
+    """A CLASS-based kernel helper gets no gate bypass: methods of a
+    top-level class in a whole-module/transition-root module are their
+    own purity and host-sync roots (they have no enclosing function
+    whose ast.walk would cover them — the hole a nested-def skip keyed
+    on ``parts[-2]`` alone would leave open)."""
+    rel = f"{PKG}/ops/hash_map.py"
+    helper = (
+        "class _KernelHelper:\n"
+        "    def merge_rows_extra(self, state):\n"
+        "        return time.time()\n"
+        "    def scan(self, state):\n"
+        "        return state.alive.sum().item()\n"
+    )
+    new = _overlay_lint(rel, lambda s: s + "\n\n" + helper)
+    assert any(
+        f.rule == "PURE003" and f.path.endswith("ops/hash_map.py") for f in new
+    ), "class-method clock read escaped the whole-module purity gate"
+    assert any(
+        f.rule == "SYNC001" and f.path.endswith("ops/hash_map.py") for f in new
+    ), "class-method .item() escaped the transition-root host-sync gate"
+
+
 def test_mutation_stale_allow_is_caught():
     """A freshly stale allow comment (rule fixed, comment left behind)
     turns the gate red (SUPPRESS001)."""

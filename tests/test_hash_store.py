@@ -1,0 +1,916 @@
+"""Hash-table dot store (ISSUE 8): the open-addressing backend must be
+OBSERVABLY IDENTICAL to the binned store — same reads, same canonical
+state (dots, contexts, leaf digests bit-for-bit), same protocol traffic
+(acks, walk blocks), and byte-identical WAL contents when fed identical
+streams — while paying no tier-promotion repacking (the only growth
+event is the ×2 rehash) and shipping dense, content-sized wire slices.
+
+Covers: kernel-level upsert/lookup/rehash units (probe-placement
+invariant, collision resolution, dead-lane reuse), seeded randomized
+hash-vs-binned parity at the kernel AND runtime level (state, WAL
+bytes, ack streams, read views), ``CtxGapError`` gap semantics,
+fleet-lane parity (vmap lane == solo hash kernel; hash fleets vs hash
+solos), snapshot backend tagging, the ``store="hash"`` tier-1 smoke
+(convergence + WAL crash recovery), the dense-extraction byte win, and
+a hypothesis upsert/extract round-trip property (importorskip-guarded).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from delta_crdt_ex_tpu import AWLWWMap, HashAWLWWMap as HashModel
+from delta_crdt_ex_tpu.api import _resolve_store, start_link
+from delta_crdt_ex_tpu.models.binned_map import AWSet, BinnedAWLWWMap, CtxGapError
+from delta_crdt_ex_tpu.models.hash_store import (
+    GROUP,
+    HashAWSet,
+    HashStore,
+    grow_table,
+)
+from delta_crdt_ex_tpu.ops import hash_map as hash_ops
+from delta_crdt_ex_tpu.runtime import sync as sync_proto, telemetry, transition
+from delta_crdt_ex_tpu.runtime.clock import LogicalClock
+from delta_crdt_ex_tpu.runtime.fleet import Fleet
+from delta_crdt_ex_tpu.runtime.transport import LocalTransport
+from tests.kernel_harness import (
+    BinnedKernelMap,
+    HashKernelMap,
+    read_binned_state,
+    read_hash_state,
+)
+from tests.test_ingest_coalesce import (
+    _wal_segment_bytes,
+    entries_only,
+    keys_for_buckets,
+)
+
+
+def canonical_dots(state) -> set:
+    """{(gid, bucket, ctr, key, valh, ts)} — the store-layout-independent
+    dot set both backends must agree on exactly."""
+    alive = np.asarray(state.alive)
+    idx = np.nonzero(alive)
+    node = np.asarray(state.node)[idx]
+    gid = np.asarray(state.ctx_gid)[node]
+    key = np.asarray(state.key)[idx]
+    bucket = key & np.uint64(state.num_buckets - 1)
+    return {
+        (int(g), int(b), int(c), int(k), int(v), int(t))
+        for g, b, c, k, v, t in zip(
+            gid.tolist(),
+            bucket.tolist(),
+            np.asarray(state.ctr)[idx].tolist(),
+            key.tolist(),
+            np.asarray(state.valh)[idx].tolist(),
+            np.asarray(state.ts)[idx].tolist(),
+        )
+    }
+
+
+def assert_canonical_equal(hs, bs, ctx=""):
+    """Hash-vs-binned state parity: identical dot sets and bit-identical
+    shared arrays (contexts + leaf digests ⇒ identical digest trees ⇒
+    identical walk traffic)."""
+    assert canonical_dots(hs) == canonical_dots(bs), ctx
+    for col in ("ctx_gid", "ctx_max", "leaf"):
+        assert np.array_equal(
+            np.asarray(getattr(hs, col)), np.asarray(getattr(bs, col))
+        ), (ctx, col)
+
+
+def assert_hash_bit_equal(s1: HashStore, s2: HashStore, ctx=""):
+    for f in dataclasses.fields(HashStore):
+        if f.name == "probe_window":
+            assert s1.probe_window == s2.probe_window, ctx
+            continue
+        assert np.array_equal(
+            np.asarray(getattr(s1, f.name)), np.asarray(getattr(s2, f.name))
+        ), (ctx, f.name)
+
+
+def assert_placement_invariant(state: HashStore, ctx=""):
+    """Every alive entry sits inside its key's probe window — the
+    invariant lookups (kills, reads, presence tests) rely on."""
+    alive = np.asarray(state.alive)
+    (idx,) = np.nonzero(alive)
+    if not len(idx):
+        return
+    base = np.asarray(hash_ops.probe_base(jnp.asarray(np.asarray(state.key)[idx]), state.table_size))
+    disp = idx - base
+    assert (disp >= 0).all() and (disp < state.probe_window).all(), (ctx, disp)
+
+
+# ---------------------------------------------------------------------------
+# kernel-level units: upsert / lookup / rehash
+
+
+def test_upsert_lookup_roundtrip():
+    m = HashKernelMap(gid=7, capacity=128, num_buckets=16)
+    m.add(5, 50, ts=1)
+    m.add(21, 60, ts=2)
+    m.add(5, 70, ts=3)  # overwrite kills the old dot
+    assert m.read() == {5: 70, 21: 60}
+    w = m.M.winners_for_keys(m.state, jnp.asarray(np.array([5, 21, 99], np.uint64)))
+    found = np.asarray(w.found)
+    assert found.tolist() == [True, True, False]
+    assert int(np.asarray(w.valh)[0]) == 70
+    m.remove(21, ts=4)
+    assert m.read() == {5: 70}
+    assert_placement_invariant(m.state)
+
+
+def test_same_window_collisions_place_distinct_lanes():
+    """Many concurrent dots of one key (distinct writers) share one
+    probe window; batch placement must give each its own lane."""
+    a = HashKernelMap(gid=1, capacity=128, num_buckets=4)
+    writers = [HashKernelMap(gid=100 + i, capacity=128, num_buckets=4) for i in range(6)]
+    for ts, w in enumerate(writers, start=1):
+        w.add(9, 10 + ts, ts=ts)
+        a.join_from(w)
+    assert a.alive_count() == 6  # six concurrent dots of key 9
+    assert a.read() == {9: 16}  # LWW: last ts wins
+    assert_placement_invariant(a.state)
+
+
+def test_window_overflow_grows_and_retries():
+    """A probe window fuller than its lanes must escape to the host
+    growth path (rehash), never silently drop an insert."""
+    st = HashStore.new(num_buckets=4, bin_capacity=16, replica_capacity=8)
+    assert st.table_size == 64
+    m = HashKernelMap(gid=1, capacity=64, num_buckets=4)
+    for i in range(120):  # >> table size: must rehash, possibly twice
+        m.add(i * 4, i, ts=i + 1)  # same bucket row, different windows
+    assert m.alive_count() == 120
+    assert m.state.table_size >= 256
+    assert_placement_invariant(m.state, "after growth")
+
+
+def test_update_churn_reuses_dead_lanes():
+    """THE steady-state property this backend exists for: an overwrite
+    kills the old dot and its insert reuses the freed lane (there are
+    no tombstones), so updating existing keys forever never fills a
+    probe window and never grows the table."""
+    m = HashKernelMap(gid=3, capacity=128, num_buckets=16)
+    for i in range(8):
+        m.add(i, i, ts=i + 1)
+    h0 = m.state.table_size
+    alive0 = m.alive_count()
+    for rnd in range(3 * m.state.probe_window):  # >> window lanes
+        for i in range(8):
+            m.add(i, 100 + rnd, ts=1000 + rnd * 8 + i)
+    assert m.state.table_size == h0, "steady-state churn grew the table"
+    assert m.alive_count() == alive0
+    assert m.read() == {i: 100 + 3 * m.state.probe_window - 1 for i in range(8)}
+    assert_placement_invariant(m.state, "after churn")
+
+
+def test_rehash_preserves_content():
+    m = HashKernelMap(gid=3, capacity=128, num_buckets=16)
+    for i in range(40):
+        m.add(i, i, ts=i + 1)
+    for i in range(0, 40, 2):
+        m.remove(i, ts=100 + i)
+    pre_read = m.read()
+    pre_dots = canonical_dots(m.state)
+    pre_leaf = np.asarray(m.state.leaf).copy()
+    grown = grow_table(m.state)
+    assert grown.table_size == 2 * m.state.table_size
+    assert read_hash_state(grown) == pre_read
+    assert canonical_dots(grown) == pre_dots
+    assert np.array_equal(np.asarray(grown.leaf), pre_leaf)
+    assert_placement_invariant(grown, "post-rehash")
+
+
+def test_rehash_is_pure_and_deterministic():
+    m = HashKernelMap(gid=3, capacity=128, num_buckets=16)
+    for i in range(30):
+        m.add(i, i, ts=i + 1)
+    before = jnp.asarray(np.asarray(m.state.key)).copy()
+    s1, ok1 = hash_ops.rehash(m.state, table_size=m.state.table_size * 2,
+                              probe_window=m.state.probe_window)
+    s2, ok2 = hash_ops.rehash(m.state, table_size=m.state.table_size * 2,
+                              probe_window=m.state.probe_window)
+    assert bool(ok1) and bool(ok2)
+    assert np.array_equal(np.asarray(m.state.key), np.asarray(before))  # input untouched
+    assert_hash_bit_equal(s1, s2, "rehash determinism")
+
+
+def test_clear_kills_everything_but_keeps_context():
+    m = HashKernelMap(gid=3, capacity=128, num_buckets=16)
+    for i in range(10):
+        m.add(i, i, ts=i + 1)
+    ctx_before = m.ctx()
+    m.clear(ts=99)
+    assert m.read() == {}
+    assert m.alive_count() == 0
+    assert m.ctx() == ctx_before  # observed dots stay covered
+    assert not np.asarray(m.state.leaf).any()
+
+
+# ---------------------------------------------------------------------------
+# kernel-level hash-vs-binned parity (the test_merge_parity pattern)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_randomized_script_parity_vs_binned(seed):
+    """One seeded op/merge script through both backends: reads, dot
+    sets, contexts and leaf digests must agree bit-for-bit at every
+    checkpoint (leaf equality ⇒ identical digest trees ⇒ the sync walk
+    cannot tell the stores apart)."""
+    rng = np.random.default_rng(seed)
+    L = 16
+    hs = {g: HashKernelMap(gid=g, capacity=128, rcap=4, num_buckets=L) for g in (100, 200)}
+    bs = {g: BinnedKernelMap(gid=g, capacity=128, rcap=4, num_buckets=L) for g in (100, 200)}
+    for ts in range(1, 40):
+        g = 100 if rng.random() < 0.5 else 200
+        k = int(rng.integers(0, 24))
+        r = rng.random()
+        if r < 0.62:
+            v = int(rng.integers(0, 100))
+            hs[g].add(k, v, ts=ts)
+            bs[g].add(k, v, ts=ts)
+        elif r < 0.9:
+            hs[g].remove(k, ts=ts)
+            bs[g].remove(k, ts=ts)
+        elif r < 0.96:
+            hs[g].clear(ts=ts)
+            bs[g].clear(ts=ts)
+        else:
+            src = 300 - g  # merge the other replica's full state
+            hs[g].join_from(hs[src])
+            bs[g].join_from(bs[src])
+        if ts % 7 == 0:
+            for g2 in (100, 200):
+                assert hs[g2].read() == bs[g2].read(), (seed, ts, g2)
+                assert_canonical_equal(hs[g2].state, bs[g2].state, (seed, ts, g2))
+    hs[100].join_from(hs[200])
+    bs[100].join_from(bs[200])
+    assert hs[100].read() == bs[100].read(), seed
+    assert_canonical_equal(hs[100].state, bs[100].state, (seed, "final"))
+    assert_placement_invariant(hs[100].state, seed)
+
+
+def test_cross_backend_slices_merge_identically():
+    """The wire slice shape is shared: a binned replica merges a dense
+    hash extraction and a hash replica merges a padded binned row slice,
+    and both land on the same canonical state."""
+    src_h = HashKernelMap(gid=9, capacity=128, num_buckets=16)
+    src_b = BinnedKernelMap(gid=9, capacity=128, num_buckets=16)
+    for i in range(20):
+        src_h.add(i, i + 1, ts=i + 1)
+        src_b.add(i, i + 1, ts=i + 1)
+    # hash → binned
+    tgt_b = BinnedKernelMap(gid=5, capacity=128, num_buckets=16)
+    tgt_b.join_from(src_h)
+    # binned → hash
+    tgt_h = HashKernelMap(gid=5, capacity=128, num_buckets=16)
+    tgt_h.join_from(src_b)
+    assert tgt_b.read() == tgt_h.read() == src_h.read()
+    assert_canonical_equal(tgt_h.state, tgt_b.state, "cross-backend")
+    # and the dense hash slice really is smaller than the binned one
+    rows = jnp.arange(16, dtype=jnp.int32)
+    sl_h = src_h.M.extract_rows(src_h.state, rows)
+    sl_b = src_b.M.extract_rows(src_b.state, rows)
+    assert sl_h.key.shape[1] <= sl_b.key.shape[1]
+
+
+def test_ctx_gap_semantics_match_binned():
+    """A delta-interval slice that skips an interval must gap on the
+    hash kernel exactly like the binned one (same ``_slice_view``):
+    ``need_ctx_gap`` set, ``gap_row`` flags the offending row, state
+    unusable, and the model wrapper raises ``CtxGapError``."""
+    src = HashKernelMap(gid=11, capacity=128, num_buckets=8)
+    bsrc = BinnedKernelMap(gid=11, capacity=128, num_buckets=8)
+    k = 3  # one bucket row
+    for ts in range(1, 7):
+        src.add(k, ts, ts=ts)
+        bsrc.add(k, ts, ts=ts)
+    rows = jnp.asarray(np.array([k & 7], np.int32))
+    # interval (3, 6] while the receiver has seen nothing: gapped
+    mk_delta = lambda m, slot_gid: m.M.extract_own_delta(
+        m.state, rows, jnp.int32(0), jnp.uint64(slot_gid), jnp.asarray(np.array([3], np.uint32))
+    )
+    sl_h = mk_delta(src, 11)
+    sl_b = mk_delta(bsrc, 11)
+    fresh_h = HashKernelMap(gid=12, capacity=128, num_buckets=8)
+    fresh_b = BinnedKernelMap(gid=12, capacity=128, num_buckets=8)
+    res_h = fresh_h.M.merge_rows(fresh_h.state, sl_h)
+    res_b = fresh_b.M.merge_rows(fresh_b.state, sl_b)
+    assert bool(res_h.need_ctx_gap) and bool(res_b.need_ctx_gap)
+    assert not bool(res_h.ok) and not bool(res_b.ok)
+    assert np.array_equal(np.asarray(res_h.gap_row), np.asarray(res_b.gap_row))
+    with pytest.raises(CtxGapError):
+        fresh_h.merge_slice(sl_h)
+    # contiguous interval (0, 6] merges clean and reads identically
+    sl_h0 = mk_delta(src, 11)._replace(
+        ctx_lo=jnp.zeros_like(sl_h.ctx_lo)
+    )
+    # rebuild with lo=0 through the proper extraction (alive mask differs)
+    sl_h0 = src.M.extract_own_delta(
+        src.state, rows, jnp.int32(0), jnp.uint64(11), jnp.asarray(np.array([0], np.uint32))
+    )
+    fresh_h.merge_slice(sl_h0)
+    assert fresh_h.read() == {k: 6}
+
+
+def test_merge_counts_match_binned():
+    """Per-row insert/kill counts feed SYNC_DONE telemetry and the
+    fleet's per-message accounting — they must match binned exactly."""
+    for seed in range(3):
+        rng = np.random.default_rng(40 + seed)
+        src_h = HashKernelMap(gid=1, capacity=128, num_buckets=8)
+        src_b = BinnedKernelMap(gid=1, capacity=128, num_buckets=8)
+        tgt_h = HashKernelMap(gid=2, capacity=128, num_buckets=8)
+        tgt_b = BinnedKernelMap(gid=2, capacity=128, num_buckets=8)
+        for ts in range(1, 25):
+            k = int(rng.integers(0, 16))
+            v = int(rng.integers(0, 50))
+            src_h.add(k, v, ts=ts)
+            src_b.add(k, v, ts=ts)
+            if rng.random() < 0.3:
+                tgt_h.add(k, v + 1, ts=ts + 100)
+                tgt_b.add(k, v + 1, ts=ts + 100)
+        # seed kills: the target observes then the source removes
+        src_h.join_from(tgt_h)
+        src_b.join_from(tgt_b)
+        rows = jnp.arange(8, dtype=jnp.int32)
+        res_h = tgt_h.merge_slice(src_h.M.extract_rows(src_h.state, rows))
+        res_b = tgt_b.merge_slice(src_b.M.extract_rows(src_b.state, rows))
+        assert int(res_h.n_inserted) == int(res_b.n_inserted), seed
+        assert int(res_h.n_killed) == int(res_b.n_killed), seed
+        assert np.array_equal(np.asarray(res_h.n_ins_row), np.asarray(res_b.n_ins_row)), seed
+        assert np.array_equal(np.asarray(res_h.n_kill_row), np.asarray(res_b.n_kill_row)), seed
+        assert tgt_h.read() == tgt_b.read(), seed
+
+
+# ---------------------------------------------------------------------------
+# runtime parity: identical streams into paired hash/binned receivers
+
+
+def _mk_sender(transport, clock, i, **opts):
+    return start_link(
+        AWLWWMap, threaded=False, transport=transport, clock=clock,
+        capacity=64, tree_depth=6, name=f"hs_snd{i}", **opts,
+    )
+
+
+def _mk_pairs(transport, clock, n, tmp=None, **opts):
+    """n hash receivers + n binned receivers, pairwise-equal node ids,
+    fed identical streams — the fleet-vs-solo parity shape with the
+    store backend as the varying axis."""
+    wal = lambda tag, i: (
+        {"wal_dir": str(tmp / f"{tag}{i}"), "fsync_mode": "none"} if tmp else {}
+    )
+    hashes = [
+        start_link(
+            AWLWWMap, threaded=False, transport=transport, clock=clock,
+            capacity=64, tree_depth=6, node_id=5000 + i, name=f"hr{i}",
+            store="hash", **wal("h", i), **opts,
+        )
+        for i in range(n)
+    ]
+    binned = [
+        start_link(
+            AWLWWMap, threaded=False, transport=transport, clock=clock,
+            capacity=64, tree_depth=6, node_id=5000 + i, name=f"br{i}",
+            store="binned", **wal("b", i), **opts,
+        )
+        for i in range(n)
+    ]
+    return hashes, binned
+
+
+def _norm_msg(m, addr_map):
+    sub = lambda v: addr_map.get(v, v)
+    t = type(m).__name__
+    if isinstance(m, sync_proto.AckMsg):
+        return (t, sub(m.clear_addr))
+    if isinstance(m, sync_proto.DiffMsg):
+        return (
+            t, sub(m.originator), sub(m.frm), m.level, m.idx.tolist(),
+            [b.tolist() for b in m.blocks], m.seq, m.log_horizon,
+        )
+    if isinstance(m, sync_proto.GetDiffMsg):
+        return (t, sub(m.originator), sub(m.frm), np.asarray(m.buckets).tolist())
+    if isinstance(m, sync_proto.GetLogMsg):
+        return (t, sub(m.frm), m.last_seq, m.applied_seq)
+    return (t, repr(m))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_hash_vs_binned_bit_for_bit_parity_randomized(seed, tmp_path):
+    """THE acceptance property (ISSUE 8): seeded randomized gossip
+    scripts fed identically to hash-store and binned-store receivers end
+    with identical reads, identical canonical state (dots + bit-equal
+    contexts/leaf digests), byte-identical WAL segment contents,
+    identical sequence numbers, identical outbound protocol streams
+    (walk replies + acks — the digest trees are bit-equal so the walk
+    cannot diverge), and pairwise-identical SYNC_DONE streams."""
+    rng = np.random.default_rng(seed)
+    transport = LocalTransport()
+    clock = LogicalClock()
+    n = 2
+    senders = [_mk_sender(transport, clock, i) for i in range(n)]
+    hashes, binned = _mk_pairs(transport, clock, n, tmp=tmp_path)
+    for i, s in enumerate(senders):
+        s.set_neighbours([hashes[i], binned[i]])
+    addr_map = {}
+    for i in range(n):
+        addr_map[hashes[i].addr] = f"recv{i}"
+        addr_map[binned[i].addr] = f"recv{i}"
+
+    done: list = []
+    handler = lambda _e, meas, meta: done.append(
+        (meta["name"], meas["keys_updated_count"])
+    )
+    telemetry.attach(telemetry.SYNC_DONE, handler)
+    try:
+        for _round in range(int(rng.integers(2, 4))):
+            for _ in range(int(rng.integers(1, 9))):
+                i = int(rng.integers(0, n))
+                ki = int(rng.integers(0, 12))
+                if rng.random() < 0.7:
+                    senders[i].mutate("add", [ki, int(rng.integers(0, 100))])
+                else:
+                    senders[i].mutate("remove", [ki])
+            for s in senders:
+                s.sync_to_all()
+            for r in hashes + binned:
+                r.process_pending()
+            for i, s in enumerate(senders):
+                back = transport.drain(s.addr)
+                frm = lambda m: getattr(m, "frm", None) or getattr(m, "clear_addr", None)
+                from_h = [_norm_msg(m, addr_map) for m in back if frm(m) == hashes[i].addr]
+                from_b = [_norm_msg(m, addr_map) for m in back if frm(m) == binned[i].addr]
+                assert from_h == from_b, (seed, i)
+                for m in back:  # walk continues: feed replies back
+                    s.handle(m)
+            for r in hashes + binned:
+                r.process_pending()
+    finally:
+        telemetry.detach(telemetry.SYNC_DONE, handler)
+
+    for i in range(n):
+        rh, rb = hashes[i], binned[i]
+        assert rh.read() == rb.read()
+        assert rh._seq == rb._seq
+        assert_canonical_equal(rh.state, rb.state, (seed, i))
+        assert _wal_segment_bytes(rh) == _wal_segment_bytes(rb) != b""
+        assert [c for nme, c in done if nme == rh.name] == [
+            c for nme, c in done if nme == rb.name
+        ], (seed, i)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_symmetric_universes_converge_identically(seed):
+    """Hash↔hash and binned↔binned universes driven by one script:
+    reads, canonical state, and sequence numbers agree — the hash store
+    also WRITES protocol-compatible slices, not just reads them."""
+    rng = np.random.default_rng(100 + seed)
+    mk_pair = lambda store: (LocalTransport(), LogicalClock(), store)
+    universes = {}
+    for store in ("hash", "binned"):
+        t, c, _ = mk_pair(store)
+        a = start_link(AWLWWMap, threaded=False, transport=t, clock=c, capacity=64,
+                       tree_depth=6, node_id=71, name=f"{store}_a", store=store)
+        b = start_link(AWLWWMap, threaded=False, transport=t, clock=c, capacity=64,
+                       tree_depth=6, node_id=72, name=f"{store}_b", store=store)
+        a.set_neighbours([b])
+        b.set_neighbours([a])
+        universes[store] = (a, b)
+    script = []
+    for _ in range(30):
+        script.append(
+            (
+                int(rng.integers(0, 2)),
+                "add" if rng.random() < 0.7 else "remove",
+                int(rng.integers(0, 10)),
+                int(rng.integers(0, 100)),
+            )
+        )
+    for who, op, k, v in script:
+        for store in ("hash", "binned"):
+            rep = universes[store][who]
+            rep.mutate(op, [k, v] if op == "add" else [k])
+    for _ in range(6):
+        for store in ("hash", "binned"):
+            a, b = universes[store]
+            a.sync_to_all(); b.sync_to_all()
+            a.process_pending(); b.process_pending()
+    ha, hb = universes["hash"]
+    ba, bb = universes["binned"]
+    assert ha.read() == hb.read() == ba.read() == bb.read(), seed
+    assert ha._seq == ba._seq and hb._seq == bb._seq
+    assert_canonical_equal(ha.state, ba.state, (seed, "a"))
+    assert_canonical_equal(hb.state, bb.state, (seed, "b"))
+
+
+def test_gap_repair_roundtrip_runtime():
+    """A lost eager push gaps the next interval; the hash receiver must
+    answer with the same GetDiffMsg repair and converge."""
+    t = LocalTransport()
+    c = LogicalClock()
+    s = _mk_sender(t, c, 0)
+    r = start_link(AWLWWMap, threaded=False, transport=t, clock=c, capacity=64,
+                   tree_depth=6, name="gap_h", store="hash")
+    s.set_neighbours([r])
+    k1, k2 = keys_for_buckets(3, 4, 2)
+    s.mutate("add", [k1, "one"])
+    s.sync_to_all()
+    t.drain(r.addr)  # the push is LOST
+    s.mutate("add", [k2, "two"])  # same bucket: next interval gaps
+    s.sync_to_all()
+    entries_only(t, r.addr)
+    r.process_pending()
+    gets = [m for m in t.drain(s.addr) if isinstance(m, sync_proto.GetDiffMsg)]
+    assert len(gets) == 1
+    s.handle(gets[0])
+    entries_only(t, r.addr)
+    r.process_pending()
+    assert r.read() == {k1: "one", k2: "two"}
+
+
+# ---------------------------------------------------------------------------
+# fleet: vmapped hash transitions + capacity bucketing
+
+
+def test_fleet_hash_merge_vmap_lane_equals_solo_kernel():
+    """Lane k of one batched ``fleet_hash_merge_rows`` dispatch is
+    bit-for-bit the solo hash ``merge_rows`` on lane k's inputs."""
+    from delta_crdt_ex_tpu.models.binned_map import stack_entry_slices
+    from delta_crdt_ex_tpu.ops.binned import RowSlice
+
+    n = 3
+    states, slices = [], []
+    for i in range(n):
+        tgt = HashKernelMap(gid=100 + i, capacity=128, num_buckets=16)
+        src = HashKernelMap(gid=500 + i, capacity=128, num_buckets=16)
+        for ts, k in enumerate(keys_for_buckets(0, 16, 5, mask=15, start=1000 * i), start=1):
+            src.add(k, k % 97, ts=ts)
+        for ts, k in enumerate(keys_for_buckets(0, 16, 2, mask=15, start=1000 * i), start=10):
+            tgt.add(k, 7, ts=ts)  # kill-pass prey
+        states.append(tgt.state)
+        slices.append(src.M.extract_rows(src.state, jnp.arange(16, dtype=jnp.int32)))
+    solo = [hash_ops.merge_rows(st, sl) for st, sl in zip(states, slices)]
+    assert all(bool(r.ok) for r in solo)
+    np_slices = [
+        RowSlice(**{c: np.asarray(getattr(s, c)) for c in RowSlice._fields})
+        for s in slices
+    ]
+    stacked_sl, _ = stack_entry_slices(np_slices)
+    res = transition.jit_fleet_hash_merge_rows(
+        transition.stack_states(states), stacked_sl
+    )
+    assert np.asarray(res.ok).all()
+    for k in range(n):
+        lane = transition.index_state(res.state, k)
+        assert_hash_bit_equal(solo[k].state, lane, f"lane {k}")
+        assert np.array_equal(np.asarray(res.n_ins_row)[k], np.asarray(solo[k].n_ins_row))
+        assert np.array_equal(np.asarray(res.n_kill_row)[k], np.asarray(solo[k].n_kill_row))
+
+
+def test_fleet_hash_members_batch_and_match_solo(tmp_path):
+    """A fleet of hash-store members batches across replicas (the
+    backend-tagged bucket key routes to the hash vmap dispatch) and
+    stays bit-identical to solo hash replicas on the same streams."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    n = 3
+    senders = [_mk_sender(transport, clock, i) for i in range(n)]
+    mk = lambda pre, i: start_link(
+        AWLWWMap, threaded=False, transport=transport, clock=clock,
+        capacity=64, tree_depth=6, node_id=8000 + i, name=f"{pre}{i}",
+        store="hash", wal_dir=str(tmp_path / f"{pre}{i}"), fsync_mode="none",
+    )
+    fleet = Fleet([mk("fh", i) for i in range(n)])
+    solos = [mk("sh", i) for i in range(n)]
+    for i, s in enumerate(senders):
+        s.set_neighbours([fleet.replicas[i], solos[i]])
+    for i, s in enumerate(senders):
+        for k in keys_for_buckets(0, 64, 3, start=777 * i):
+            s.mutate("add", [k, k])
+        s.sync_to_all()
+    for r in list(fleet.replicas) + solos:
+        entries_only(transport, r.addr)
+    fleet.drain()
+    for r in solos:
+        r.process_pending()
+    st = fleet.stats()
+    assert st["dispatches"] >= 1  # the hash batch WAS vmapped
+    for i in range(n):
+        rf, rs = fleet.replicas[i], solos[i]
+        assert rf.read() == rs.read()
+        assert rf._seq == rs._seq
+        assert_hash_bit_equal(rf.state, rs.state, i)
+        assert _wal_segment_bytes(rf) == _wal_segment_bytes(rs)
+
+
+def _keys_for_probe_base(table_size: int, n: int, start: int = 1) -> list:
+    """``n`` int key terms whose probe windows share one hot base —
+    drives window pressure directly (the growth advisory's signal),
+    independent of table size."""
+    from delta_crdt_ex_tpu.utils.hashing import key_hash64
+
+    base_of = lambda k: int(
+        np.asarray(
+            hash_ops.probe_base(
+                jnp.asarray(np.uint64(key_hash64(k))), table_size
+            )
+        )
+    )
+    k = start
+    target = base_of(k)
+    out = [k]
+    while len(out) < n:
+        k += 1
+        if base_of(k) == target:
+            out.append(k)
+    return out
+
+
+def test_fleet_window_advisory_grows_off_batch_path(tmp_path):
+    """A fleet-held member whose hot probe window nears overflow in a
+    batched merge grows via the post-commit advisory
+    (``grow_store_advised`` — no mid-batch escape), and stays
+    bit-identical to a solo replica fed the same stream (whose
+    ``merge_rows_into`` runs the same policy)."""
+    transport = LocalTransport()
+    clock = LogicalClock()
+    senders = [_mk_sender(transport, clock, i) for i in range(2)]
+    mk = lambda pre, i: start_link(
+        AWLWWMap, threaded=False, transport=transport, clock=clock,
+        capacity=256, tree_depth=6, node_id=8800 + i, name=f"{pre}{i}",
+        store="hash", wal_dir=str(tmp_path / f"{pre}{i}"), fsync_mode="none",
+    )
+    fleet = Fleet([mk("adv_f", i) for i in range(2)])
+    solos = [mk("adv_s", i) for i in range(2)]
+    for i, s in enumerate(senders):
+        s.set_neighbours([fleet.replicas[i], solos[i]])
+    h0 = fleet.replicas[0].state.table_size
+    w = fleet.replicas[0].state.probe_window
+    # one hot window: wave 1 fills it just below the ¾ advisory line,
+    # wave 2 crosses the line but stays far from overflow (the advisory
+    # grows the members at commit, no escape), wave 3 lands in the
+    # grown table where the hot base has split in two
+    hot = _keys_for_probe_base(h0, 3 * w // 4 + 2)
+    waves = (hot[: 3 * w // 4 - 1], hot[3 * w // 4 - 1 :], [900_001, 900_002])
+    for wave in waves:
+        for s in senders:
+            s.mutate_batch("add", [[k, k % 91] for k in wave])
+            s.sync_to_all()
+        for r in list(fleet.replicas) + solos:
+            entries_only(transport, r.addr)
+        fleet.drain()
+        for r in solos:
+            r.process_pending()
+    assert fleet.stats()["fallbacks"]["escape"] == 0, "advisory must preempt escapes"
+    for i in range(2):
+        rf, rs = fleet.replicas[i], solos[i]
+        assert rf.state.table_size > h0, "window advisory never grew the member"
+        assert rf.read() == rs.read()
+        assert_hash_bit_equal(rf.state, rs.state, i)
+        assert _wal_segment_bytes(rf) == _wal_segment_bytes(rs)
+
+
+def test_batch_key_declares_backend():
+    """Backends declare their own batch-compatibility key (the fleet
+    must never stack a hash member with a binned one)."""
+    t = LocalTransport()
+    c = LogicalClock()
+    h = start_link(AWLWWMap, threaded=False, transport=t, clock=c, capacity=64,
+                   tree_depth=6, name="geo_h", store="hash")
+    b = start_link(AWLWWMap, threaded=False, transport=t, clock=c, capacity=64,
+                   tree_depth=6, name="geo_b", store="binned")
+    gh, gb = h._geometry(), b._geometry()
+    assert gh[0] == "hash" and gb[0] == "binned"
+    assert gh != gb
+    # hash key moves only on rehash (capacity), not on content growth
+    assert gh[2] == h.state.table_size
+
+
+# ---------------------------------------------------------------------------
+# runtime smoke: store="hash" end-to-end (the tier-1 anti-bit-rot gate)
+
+
+def test_store_hash_e2e_convergence_and_wal_recovery(tmp_path):
+    t = LocalTransport()
+    c = LogicalClock()
+    mk = lambda name, **kw: start_link(
+        AWLWWMap, threaded=False, transport=t, clock=c, capacity=64,
+        tree_depth=6, name=name, store="hash", **kw,
+    )
+    a = mk("e2e_a", wal_dir=str(tmp_path / "a"), fsync_mode="none")
+    b = mk("e2e_b")
+    a.set_neighbours([b])
+    b.set_neighbours([a])
+    for i in range(40):
+        a.mutate("add", [f"k{i}", i])
+    b.mutate("add", ["k1", "theirs"])
+    for _ in range(6):
+        a.sync_to_all(); b.sync_to_all()
+        a.process_pending(); b.process_pending()
+    assert a.read() == b.read() and len(a.read()) == 40
+    want = a.read()
+    node_id = a.node_id
+    a.crash()
+    reborn = mk("e2e_a", wal_dir=str(tmp_path / "a"))
+    assert reborn.node_id == node_id
+    assert reborn.read() == want
+    # fresh dots post-recovery land cleanly
+    reborn.mutate("add", ["post", 1])
+    assert reborn.read() == {**want, "post": 1}
+    reborn.crash()
+
+
+def test_snapshot_records_store_backend(tmp_path):
+    """A hash-store WAL/snapshot must refuse to rehydrate a binned
+    replica (and vice versa) with the extraction-migration pointer."""
+    t = LocalTransport()
+    c = LogicalClock()
+    a = start_link(AWLWWMap, threaded=False, transport=t, clock=c, capacity=64,
+                   tree_depth=6, name="tagged", store="hash",
+                   wal_dir=str(tmp_path), fsync_mode="none", compact_every=1)
+    a.mutate("add", ["k", 1])  # compact_every=1: snapshot written
+    a.crash()
+    with pytest.raises(ValueError, match="extraction"):
+        start_link(AWLWWMap, threaded=False, transport=t, clock=c, capacity=64,
+                   tree_depth=6, name="tagged", store="binned",
+                   wal_dir=str(tmp_path), fsync_mode="none")
+
+
+def test_resolve_store_mapping():
+    assert _resolve_store(BinnedAWLWWMap, None) is BinnedAWLWWMap
+    assert _resolve_store(BinnedAWLWWMap, "hash") is HashModel
+    assert _resolve_store(HashModel, "binned") is BinnedAWLWWMap
+    assert _resolve_store(AWSet, "hash") is HashAWSet
+    assert _resolve_store(HashAWSet, "hash") is HashAWSet
+    with pytest.raises(ValueError, match="unknown store"):
+        _resolve_store(BinnedAWLWWMap, "flat")
+
+
+def test_hash_awset_reads_as_set():
+    t = LocalTransport()
+    c = LogicalClock()
+    a = start_link(AWSet, threaded=False, transport=t, clock=c, capacity=64,
+                   tree_depth=6, name="hset", store="hash")
+    a.mutate("add", ["x"])
+    a.mutate("add", ["y"])
+    a.mutate("remove", ["x"])
+    assert a.read() == {"y"}
+
+
+# ---------------------------------------------------------------------------
+# dense extraction: the byte win + determinism
+
+
+def test_dense_extraction_is_smaller_and_deterministic():
+    """The hash store ships content-sized slices: at low bucket fill the
+    lane tier undercuts the binned bin tier, dead lanes are zeroed, and
+    repeated extraction is byte-identical (deterministic arrival
+    order)."""
+    h = HashKernelMap(gid=4, capacity=1024, num_buckets=16)
+    b = BinnedKernelMap(gid=4, capacity=1024, num_buckets=16)
+    for i in range(24):  # ~1.5 entries/bucket vs bin tier 64
+        h.add(i, i, ts=i + 1)
+        b.add(i, i, ts=i + 1)
+    rows = jnp.arange(16, dtype=jnp.int32)
+    sl_h = h.M.extract_rows(h.state, rows)
+    sl_b = b.M.extract_rows(b.state, rows)
+    assert sl_h.key.shape[1] < sl_b.key.shape[1]
+    lane_bytes = lambda sl: sum(
+        np.asarray(getattr(sl, c)).nbytes
+        for c in ("key", "valh", "ts", "node", "ctr", "alive")
+    )
+    assert lane_bytes(sl_h) < lane_bytes(sl_b)
+    # dead lanes zeroed + deterministic bytes
+    sl_h2 = h.M.extract_rows(h.state, rows)
+    for c in ("key", "valh", "ts", "node", "ctr", "alive"):
+        a1, a2 = np.asarray(getattr(sl_h, c)), np.asarray(getattr(sl_h2, c))
+        assert np.array_equal(a1, a2), c
+        if c != "alive":
+            assert not a1[~np.asarray(sl_h.alive)].any(), c
+
+
+def test_catchup_stats_record_chunk_fill(tmp_path):
+    """The log-ship server surfaces shipped lanes vs entries per store
+    (the PR 4 padding-overhead finding, now observable): a hash server's
+    chunk fill ratio must beat the binned server's on the same data."""
+    ratios = {}
+    for store in ("hash", "binned"):
+        t = LocalTransport()
+        c = LogicalClock()
+        w = start_link(AWLWWMap, threaded=False, transport=t, clock=c, capacity=1024,
+                       tree_depth=6, name=f"cw_{store}", store=store,
+                       wal_dir=str(tmp_path / store), fsync_mode="none")
+        r = start_link(AWLWWMap, threaded=False, transport=t, clock=c, capacity=1024,
+                       tree_depth=6, name=f"cr_{store}", store=store)
+        for i in range(48):
+            w.mutate("add", [f"k{i}", i])
+        w.set_neighbours([r])
+        w.sync_to_all()
+        # force the log-ship path: the receiver requests the WAL suffix
+        r._request_catchup(w.addr)
+        w.process_pending()
+        r.process_pending()
+        w.process_pending()
+        r.process_pending()
+        assert r.read() == w.read()
+        cu = w.stats()["catchup"]
+        assert cu["store"] == store
+        assert cu["chunks_served"] >= 1
+        assert cu["lanes_shipped"] > 0 and cu["entries_shipped"] > 0
+        assert cu["entries_shipped"] == 48  # same content either way
+        ratios[store] = (cu["chunk_fill_ratio"], cu["lanes_shipped"])
+    # dense hash chunks ship far fewer lanes for the same entries (the
+    # pow4 dense tier still pads a little — but never to the bin tier)
+    assert ratios["hash"][1] < ratios["binned"][1]
+    assert ratios["hash"][0] >= 2 * ratios["binned"][0]
+
+
+# ---------------------------------------------------------------------------
+# Pallas point-lookup kernel (interpret mode = CPU-checkable)
+
+
+def test_pallas_probe_lookup_interpret_matches_reference():
+    m = HashKernelMap(gid=21, capacity=256, num_buckets=16)
+    for i in range(30):
+        m.add(i, i * 3 + 1, ts=i + 1)
+    for i in range(0, 30, 3):
+        m.remove(i, ts=100 + i)
+    keys = np.arange(0, 34, dtype=np.uint64)
+    try:
+        out = np.asarray(
+            hash_ops.probe_lookup_pallas(jnp.asarray(keys), m.state, interpret=True)
+        )
+    except Exception as e:  # pallas interpret API churn: only the
+        pytest.skip(f"pallas interpret unavailable: {e!r}")  # TPU path may skip
+    ref = m.M.winners_for_keys(m.state, jnp.asarray(keys))
+    found_ref = np.asarray(ref.found)
+    assert np.array_equal(out[:, 0].astype(bool), found_ref)
+    # winner columns agree wherever found (ctr + valh identify the dot)
+    sel = found_ref
+    assert np.array_equal(out[sel, 3].astype(np.uint32), np.asarray(ref.ctr)[sel])
+    assert np.array_equal(out[sel, 4].astype(np.uint32), np.asarray(ref.valh)[sel])
+    # free-slot probe: a returned lane really is free (dead) and in-window
+    free = out[:, 7]
+    in_table = free < m.state.table_size
+    assert (~np.asarray(m.state.alive)[free[in_table]]).all()
+
+
+def test_probed_lookup_fn_reports_selection():
+    fn, tag = hash_ops.probed_lookup_fn()
+    # CPU tier-1: the probe must fall back (and say why) or succeed
+    assert (fn is None and tag.startswith("xla")) or tag == "pallas"
+
+
+# ---------------------------------------------------------------------------
+# hypothesis property: upsert/extract round-trip
+
+
+def test_property_upsert_extract_roundtrip():
+    hypothesis = pytest.importorskip("hypothesis")
+    from hypothesis import HealthCheck, given, settings
+    from hypothesis import strategies as st
+
+    ops = st.lists(
+        st.tuples(
+            st.sampled_from(["add", "remove"]),
+            st.integers(min_value=0, max_value=15),
+            st.integers(min_value=0, max_value=99),
+        ),
+        min_size=1,
+        max_size=25,
+    )
+
+    @settings(
+        max_examples=20,
+        deadline=None,
+        suppress_health_check=[HealthCheck.too_slow],
+    )
+    @given(script=ops)
+    def run(script):
+        m = HashKernelMap(gid=77, capacity=128, num_buckets=8)
+        spec: dict[int, int] = {}
+        for ts, (op, k, v) in enumerate(script, start=1):
+            if op == "add":
+                m.add(k, v, ts=ts)
+                spec[k] = v
+            else:
+                m.remove(k, ts=ts)
+                spec.pop(k, None)
+        assert m.read() == spec
+        # extract everything dense and replay into a fresh table: the
+        # round-trip must reproduce the read AND the canonical dot set
+        sl = m.M.extract_rows(m.state, jnp.arange(8, dtype=jnp.int32))
+        fresh = HashKernelMap(gid=88, capacity=128, num_buckets=8)
+        fresh.merge_slice(sl)
+        assert fresh.read() == spec
+        assert canonical_dots(fresh.state) == canonical_dots(m.state)
+        assert_placement_invariant(fresh.state)
+
+    run()

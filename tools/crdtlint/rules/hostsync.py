@@ -48,8 +48,11 @@ _OP_MODULE_MARKERS = (".ops.", ".parallel.")
 #: modules whose every function is a jit entry root by contract (the
 #: pure state-transition layer of the replica split — "jit-able, no
 #: host syncs" is its definition, so the gate must not depend on some
-#: caller happening to wrap each function today)
-_TRANSITION_MODULE_MARKERS = (".runtime.transition",)
+#: caller happening to wrap each function today). The hash-store kernel
+#: module (ISSUE 8) carries the same contract: its host-side policy
+#: wrappers live in ``models/hash_store.py``, everything in
+#: ``ops/hash_map.py`` must trace clean.
+_TRANSITION_MODULE_MARKERS = (".runtime.transition", ".ops.hash_map")
 
 
 def _is_jit_call(node: ast.Call) -> bool:
@@ -118,12 +121,20 @@ def _reachable_functions(project: Project) -> set[int]:
             resolved = project.resolve_function(mod, expr)
             if resolved is not None:
                 push(*resolved)
-        # pure-transition modules: every top-level function is an entry
-        # root by contract (see module docstring)
+        # pure-transition modules: every function is an entry root by
+        # contract (see module docstring) — including methods of
+        # top-level classes, or a class-based kernel helper would get a
+        # silent gate bypass
         if any(m in mod.name + "." for m in _TRANSITION_MODULE_MARKERS):
             for node in mod.tree.body:
                 if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     push(mod, node)
+                elif isinstance(node, ast.ClassDef):
+                    for sub in node.body:
+                        if isinstance(
+                            sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            push(mod, sub)
 
     while work:
         mod, fn = work.pop()

@@ -36,6 +36,12 @@ RULE_IMPURE = "PURE003"
 
 _NAME_RE = re.compile(r"(^|_)(join|merge|delta)(_|$|s$)")
 _SCOPE_MARKERS = (".ops.", ".models.", ".runtime.transition")
+#: modules where EVERY function is a lattice op by contract, whatever
+#: its name — the hash-store kernel module (ISSUE 8): ``rehash``,
+#: ``row_apply``, extraction and probing all feed anti-entropy state
+#: that must replicate bit-for-bit, so an impure rehash is exactly as
+#: gate-red as an impure merge
+_WHOLE_MODULE_MARKERS = (".ops.hash_map",)
 _IMPURE_ROOTS = {"time", "random", "secrets", "uuid"}
 _IMPURE_CHAINS = ("np.random.", "numpy.random.", "datetime.")
 
@@ -132,13 +138,24 @@ def check_purity(project: Project) -> list[Finding]:
     for mod in project.modules.values():
         if not _in_scope(mod):
             continue
+        whole = any(m in mod.name + "." for m in _WHOLE_MODULE_MARKERS)
         seen_lines: set[tuple[int, str]] = set()
-        for parts, fn in iter_function_defs(mod.tree):
-            if not _NAME_RE.search(fn.name):
+        defs = list(iter_function_defs(mod.tree))
+        # qualnames that are FUNCTIONS (classes never yield their own
+        # entry): a nested def is covered by ast.walk of its enclosing
+        # function, but a CLASS method has no walked parent and must be
+        # its own root — `parts[-2]` alone can't tell the two apart
+        fn_parts = {parts for parts, _ in defs}
+        for parts, fn in defs:
+            if not (whole or _NAME_RE.search(fn.name)):
                 continue
             # nested defs of a matching op are covered by ast.walk of the
             # parent; skip them as separate roots to avoid double reports
-            if len(parts) >= 2 and _NAME_RE.search(parts[-2]):
+            if (
+                len(parts) >= 2
+                and parts[:-1] in fn_parts
+                and (whole or _NAME_RE.search(parts[-2]))
+            ):
                 continue
             for f in _check_function(mod, fn):
                 key = (f.line, f.rule)
