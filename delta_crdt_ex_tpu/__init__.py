@@ -45,6 +45,8 @@ __all__ = [
     "FileStorage",
     "Fleet",
     "MemoryStorage",
+    "Observability",
+    "ObsServer",
     "Replica",
     "Storage",
     "WalLog",
@@ -71,6 +73,8 @@ _EXPORTS = {
     "HashAWSet": ("delta_crdt_ex_tpu.models.hash_store", "HashAWSet"),
     "Fleet": ("delta_crdt_ex_tpu.runtime.fleet", "Fleet"),
     "MemoryStorage": ("delta_crdt_ex_tpu.runtime.storage", "MemoryStorage"),
+    "Observability": ("delta_crdt_ex_tpu.runtime.metrics", "Observability"),
+    "ObsServer": ("delta_crdt_ex_tpu.runtime.obs_server", "ObsServer"),
     "FileStorage": ("delta_crdt_ex_tpu.runtime.storage", "FileStorage"),
     "Replica": ("delta_crdt_ex_tpu.runtime.replica", "Replica"),
     "Storage": ("delta_crdt_ex_tpu.runtime.storage", "Storage"),

@@ -146,6 +146,21 @@ def start_link(
     ``log_shipping``, ``catchup_chunk_rows``, ``catchup_suffix_ratio``
     (engage the clamped stream when suffix ≥ ratio × prefix, default
     4); observability under ``Replica.stats()["catchup"]``.
+
+    Observability plane (ISSUE 9, off by default): ``obs=True`` joins
+    the process-wide :class:`~delta_crdt_ex_tpu.runtime.metrics.
+    Observability` plane (``obs=<Observability>`` an explicit one) —
+    the always-attached metrics bridge folds every telemetry event
+    into a Prometheus-style registry, scrape-time collectors poll
+    mailbox depth / WAL footprint / transport bytes, a bounded flight
+    recorder keeps the replica's recent structured events (dumped on
+    :meth:`Replica.crash`), and the dot-provenance lag tracer samples
+    local commits so peers' watermark advances yield per-peer
+    convergence-lag histograms with ZERO wire changes. Serve
+    ``/metrics`` + ``/healthz`` + ``/varz`` with
+    ``obs_plane.serve(port=...)``; the existing ``stats()`` dicts are
+    unchanged (MIGRATING.md). Disabled (the default) the hot paths pay
+    only ``has_handlers`` lock checks.
     """
     opts.setdefault("sync_interval", DEFAULT_SYNC_INTERVAL)
     opts.setdefault("max_sync_size", DEFAULT_MAX_SYNC_SIZE)
@@ -188,11 +203,26 @@ def start_fleet(
     are ordinary :class:`Replica` handles for ``mutate``/``read``/
     ``set_neighbours``. ``threaded=False`` leaves driving to the
     caller (``fleet.tick()`` / ``fleet.drain()`` +
-    ``fleet.run_duties()``)."""
+    ``fleet.run_duties()``).
+
+    ``obs=`` wires the whole fleet into ONE observability plane
+    (``True`` = the process-wide default, or an explicit
+    :class:`~delta_crdt_ex_tpu.runtime.metrics.Observability`): every
+    member registers its varz/health/metric sources and the fleet adds
+    its own tick-freshness health check plus occupancy / ragged-fill
+    gauges — see :func:`start_link` for the plane's full surface."""
     if names is not None and len(names) != n:
         raise ValueError(f"{len(names)} names for {n} replicas")
     opts.setdefault("sync_interval", DEFAULT_SYNC_INTERVAL)
     opts.setdefault("max_sync_size", DEFAULT_MAX_SYNC_SIZE)
+    # resolve the obs knob ONCE so every member and the fleet share one
+    # plane (obs=True would resolve identically per member, but an
+    # explicit instance must not be re-validated N times either way)
+    from delta_crdt_ex_tpu.runtime import metrics as _metrics
+
+    obs_plane = _metrics.resolve_obs(opts.pop("obs", None))
+    if obs_plane is not None:
+        opts["obs"] = obs_plane
     crdt_module = _resolve_store(crdt_module, store)
     replicas = []
     for i in range(n):
@@ -200,7 +230,7 @@ def start_fleet(
         if names is not None:
             member["name"] = names[i]
         replicas.append(Replica(crdt_module, **member))
-    fleet = Fleet(replicas, min_batch=min_batch)
+    fleet = Fleet(replicas, min_batch=min_batch, obs=obs_plane)
     if threaded:
         fleet.start()
     return fleet

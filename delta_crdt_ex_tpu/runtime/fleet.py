@@ -59,7 +59,12 @@ import jax
 
 from delta_crdt_ex_tpu.models.binned import pow2_tier
 from delta_crdt_ex_tpu.models.binned_map import stack_entry_slices
-from delta_crdt_ex_tpu.runtime import sync as sync_proto, telemetry, transition
+from delta_crdt_ex_tpu.runtime import (
+    metrics as metrics_mod,
+    sync as sync_proto,
+    telemetry,
+    transition,
+)
 from delta_crdt_ex_tpu.runtime.replica import Replica
 
 
@@ -91,7 +96,7 @@ class Fleet:
     interval checkpoints) plus the batched ingress drain.
     """
 
-    def __init__(self, replicas: list, *, min_batch: int = 2):
+    def __init__(self, replicas: list, *, min_batch: int = 2, obs=None):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
         for r in replicas:
@@ -130,6 +135,9 @@ class Fleet:
         self._real_rows = 0
         self._padded_rows = 0
         self._fallbacks = {"singleton": 0, "shape": 0, "escape": 0, "stale": 0}
+        #: tick-freshness heartbeat for /healthz (a wedged fleet loop —
+        #: stuck dispatch, dead thread — goes stale and flips unready)
+        self._tick_ts = time.monotonic()
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
@@ -137,6 +145,12 @@ class Fleet:
             # member notify() wakes the FLEET loop, not a per-replica one
             r.notify = self._member_notify  # type: ignore[method-assign]
             r._in_fleet = True
+        #: observability plane (ISSUE 9): the fleet registers its own
+        #: varz/health sources + a scrape-time collector for occupancy /
+        #: fill-ratio / tick gauges; members register themselves
+        self._obs = metrics_mod.resolve_obs(obs)
+        if self._obs is not None:
+            self._obs.register_fleet(self)
 
     def _member_notify(self) -> None:
         if self._thread is not None:
@@ -152,6 +166,10 @@ class Fleet:
         ``Replica.process_pending``'s single drain: sustained ingress
         cannot starve the periodic duties between ticks."""
         t0 = time.perf_counter()
+        with self._lock:
+            # refreshed every tick (busy or idle): /healthz readiness is
+            # "the loop is turning", not "traffic is flowing"
+            self._tick_ts = time.monotonic()
         per_member: list = []
         n_msgs = 0
         for rep in self.replicas:
@@ -286,15 +304,17 @@ class Fleet:
         probe_window = getattr(stacked_in, "probe_window", 0)
         dt = time.perf_counter() - t0
         # per-row count readback is lazy and shared: one device_get for
-        # the whole stack, paid only if any SYNC_DONE handler exists
+        # the whole stack, paid only if any SYNC_DONE handler exists.
+        # Capture JUST the two stacked count arrays — a closure over
+        # ``res`` would pin the whole stacked result (incl. the stacked
+        # states) for as long as any member's deferral window parks the
+        # fn, defeating XLA's input-buffer reuse on later dispatches
         counts_cell: list = []
 
-        def counts_for(lane):
+        def counts_for(lane, ins_rows=res.n_ins_row, kill_rows=res.n_kill_row):
             def fn():
                 if not counts_cell:
-                    counts_cell.append(
-                        jax.device_get((res.n_ins_row, res.n_kill_row))
-                    )
+                    counts_cell.append(jax.device_get((ins_rows, kill_rows)))
                 ins, kill = counts_cell[0]
                 return ins[lane], kill[lane]
 
@@ -443,6 +463,8 @@ class Fleet:
             self._wake.set()
             self._thread.join(timeout=5)
             self._thread = None
+        if self._obs is not None:
+            self._obs.unregister_fleet(self)
         for rep in self.replicas:
             rep.stop()
             self.drain()  # surviving members process the goodbye sync
@@ -482,6 +504,30 @@ class Fleet:
                 ),
                 "fallbacks": dict(self._fallbacks),
             }
+
+    def obs_varz(self) -> dict:
+        """The fleet's ``/varz`` stanza: the UNCHANGED :meth:`stats`
+        dict under a typed envelope (additive surface, MIGRATING.md)."""
+        return {"kind": "fleet", "stats": self.stats()}
+
+    def health(self) -> dict:
+        """Readiness for ``/healthz``: the shared event loop's tick is
+        fresh (when threaded — deterministic drives pass trivially).
+        Member-level WAL/neighbour checks ride each member's own
+        :meth:`Replica.health` source."""
+        with self._lock:
+            tick_ts = self._tick_ts
+        ok = True
+        if self._thread is not None:
+            fresh = time.monotonic() - tick_ts < max(
+                5 * min(r.sync_interval for r in self.replicas), 2.0
+            )
+            ok = self._thread.is_alive() and fresh
+        return {
+            "ok": ok,
+            "loop_responsive": ok,
+            "replicas": len(self.replicas),
+        }
 
 
 def start_fleet(replicas: list, *, threaded: bool = True, **opts) -> Fleet:

@@ -49,6 +49,7 @@ from delta_crdt_ex_tpu.models.binned import BinnedStore, pow2_tier, pow4_tier
 from delta_crdt_ex_tpu.models.binned_map import BinnedAWLWWMap, CtxGapError
 from delta_crdt_ex_tpu.ops.apply import OP_ADD, OP_CLEAR, OP_PAD, OP_REMOVE
 from delta_crdt_ex_tpu.runtime import (
+    metrics as metrics_mod,
     sync as sync_proto,
     telemetry,
     tracing,
@@ -141,6 +142,7 @@ class Replica:
         catchup_chunk_rows: int = 1024,
         catchup_suffix_ratio: float = 4.0,
         gc_interval_ops: int = 4096,
+        obs=None,
         device=None,
     ):
         # max_sync_size validation (reference raises, causal_crdt.ex:52-62)
@@ -206,6 +208,32 @@ class Replica:
         self.sync_timeout = (
             sync_timeout if sync_timeout is not None else max(10 * sync_interval, 2.0)
         )
+
+        #: observability plane (ISSUE 9): ``obs=True`` resolves to the
+        #: process-wide plane, an :class:`~delta_crdt_ex_tpu.runtime.
+        #: metrics.Observability` is used as-is, ``None``/``False``
+        #: disables it — the ``has_handlers`` guards then keep disabled
+        #: telemetry at a lock check on every hot path. The flight
+        #: recorder is the per-replica black box (bounded ring of
+        #: structured events, dumped on :meth:`crash`); the lag tracer
+        #: samples local commits so peers' watermark advances yield
+        #: per-peer convergence-lag histograms with zero wire changes.
+        self._obs = metrics_mod.resolve_obs(obs)
+        self.flight = (
+            self._obs.recorder(self.name) if self._obs is not None else None
+        )
+        self._lag = self._obs.lag if self._obs is not None else None
+        self._loop_ts = time.monotonic()
+        #: active only inside a ``process_pending`` drain pass: SYNC_DONE
+        #: emissions append ``(fetch, emit)`` pairs here instead of
+        #: reading the kernel's keys-updated accounting immediately —
+        #: per-group device readbacks mid-drain block the host on each
+        #: group's merge chain AND each pay a fixed transfer dispatch.
+        #: The flush fetches every pending accounting pytree with ONE
+        #: ``jax.device_get`` and then emits, in order. The list is
+        #: swapped in/out and appended to under ``_lock``; the flush
+        #: runs lock-free after the drain loop.
+        self._telemetry_defer: list | None = None
 
         self.eager_deltas = eager_deltas
         self._lock = threading.RLock()
@@ -425,6 +453,10 @@ class Replica:
 
         self.transport.register(self.name, self)
         self._warmup()
+        if self._obs is not None:
+            # last: the plane's scrape-time collector polls stats(), so
+            # every field it reads must already exist
+            self._obs.register_replica(self)
 
     @property
     def state(self) -> BinnedStore:
@@ -600,6 +632,11 @@ class Replica:
     def _durable_batch(self, batch: list, ts) -> None:
         """Durability point for one local mutation batch — the single
         definition of the ``batch`` record schema (both flush paths)."""
+        if self._lag is not None and not self._replaying:
+            # sample THIS local commit for replication-lag tracing (the
+            # tracer keeps every sample_every-th seq; replay re-applies
+            # history, it does not commit fresh writes)
+            self._lag.note_commit(self.addr, self._seq)
         self._durable(
             lambda: {
                 "kind": "batch",
@@ -665,16 +702,21 @@ class Replica:
             # active segment's fd/index is replica-lock-serialised state
             self._wal.rotate()  # still bound the active segment's size
         self._wal_unc = 0
-        telemetry.execute(
-            telemetry.WAL_COMPACT,
-            {
-                "segments_deleted": deleted,
-                "bytes_reclaimed": freed,
-                "ack_floor": floor,
-                "duration_s": time.perf_counter() - t0,
-            },
-            {"name": self.name},
+        self._flight(
+            "wal_compact", segments_deleted=deleted, bytes_reclaimed=freed,
+            ack_floor=floor,
         )
+        if telemetry.has_handlers(telemetry.WAL_COMPACT):
+            telemetry.execute(
+                telemetry.WAL_COMPACT,
+                {
+                    "segments_deleted": deleted,
+                    "bytes_reclaimed": freed,
+                    "ack_floor": floor,
+                    "duration_s": time.perf_counter() - t0,
+                },
+                {"name": self.name},
+            )
 
     def _wal_replay(self, records: list, t0: float) -> None:
         """Replay recovered records past the snapshot's sequence number
@@ -714,15 +756,19 @@ class Replica:
         # clock continuity: replayed local stamps must not out-rank new
         # writes (the snapshot's last_ts was observed in _rehydrate)
         self.clock.observe(max_ts)
-        telemetry.execute(
-            telemetry.WAL_RECOVER,
-            {
-                "records": applied,
-                "bytes": self._wal.recovered_bytes,
-                "duration_s": time.perf_counter() - t0,
-            },
-            {"name": self.name},
+        self._flight(
+            "wal_recover", records=applied, bytes=self._wal.recovered_bytes,
         )
+        if telemetry.has_handlers(telemetry.WAL_RECOVER):
+            telemetry.execute(
+                telemetry.WAL_RECOVER,
+                {
+                    "records": applied,
+                    "bytes": self._wal.recovered_bytes,
+                    "duration_s": time.perf_counter() - t0,
+                },
+                {"name": self.name},
+            )
 
     def _replay_entries(self, rec: dict) -> None:
         a = rec["arrays"]
@@ -756,7 +802,15 @@ class Replica:
             # live CtxGapError path in _handle_entries_inner)
             self._gc_pressure += len(rec["payloads"])
             return
-        self._note_state_changed(lambda: int(res.n_inserted) + int(res.n_killed))
+        self._note_state_changed(
+            # default-arg capture of JUST the two count scalars: a
+            # closure over ``res`` parks the whole MergeRowsResult —
+            # including ``res.state`` — in the drain's deferral
+            # window, pinning every superseded store generation and
+            # defeating XLA's input-buffer reuse on each subsequent
+            # merge (a full-store copy per dispatch)
+            lambda ins=res.n_inserted, kill=res.n_killed: (ins, kill)
+        )
         self._gc_pressure += len(rec["payloads"]) + int(res.n_killed)
         self._maybe_gc()
 
@@ -1189,11 +1243,20 @@ class Replica:
                 self._grown_telemetry(self._state)
 
     def _grown_telemetry(self, state) -> None:
-        telemetry.execute(
-            telemetry.CAPACITY_GROWN,
-            {"capacity": state.capacity, "replica_capacity": state.replica_capacity},
-            {"name": self.name},
-        )
+        self._flight("growth", capacity=int(state.capacity))
+        if telemetry.has_handlers(telemetry.CAPACITY_GROWN):
+            telemetry.execute(
+                telemetry.CAPACITY_GROWN,
+                {"capacity": state.capacity, "replica_capacity": state.replica_capacity},
+                {"name": self.name},
+            )
+
+    def _flight(self, kind: str, **fields) -> None:
+        """Record one structured event in the per-replica flight
+        recorder (no-op without an observability plane): the bounded
+        black box :meth:`crash` dumps and chaos/soak tests query."""
+        if self.flight is not None:
+            self.flight.record(kind, **fields)
 
     # ------------------------------------------------------------------
     # diffs, callback, telemetry (reference causal_crdt.ex:344-404)
@@ -1276,19 +1339,38 @@ class Replica:
         self, count_fn: Callable[[], int], keep_read_cache: bool = False
     ) -> None:
         """Invalidate read/tree caches and emit ``SYNC_DONE`` telemetry.
-        ``count_fn`` runs only when a handler is attached — the count may
-        require a device→host readback. ``keep_read_cache`` is set by the
-        local flush path when it already maintained the cache in place."""
+        ``count_fn`` runs only when a handler is attached and may return
+        either a host int or a tuple of (device or host) scalars to sum
+        — callers holding device accounting MUST pass the raw device
+        values, not ``int()`` them: the mid-drain deferral window
+        fetches every parked value with ONE batched ``device_get``, and
+        a per-callback ``int()`` would serialise one sync round trip
+        per dispatch group instead (measured ~90 ms/drain at depth-38
+        coalesce fan-in — the cost that made the obs plane look 25%
+        expensive). ``keep_read_cache`` is set by the local flush path
+        when it already maintained the cache in place."""
         self._tree = None
         if not keep_read_cache:
             self._read_cache = None
             self._read_cache_kh = None
         if telemetry.has_handlers(telemetry.SYNC_DONE):
-            telemetry.execute(
-                telemetry.SYNC_DONE,
-                {"keys_updated_count": int(count_fn())},
-                {"name": self.name},
-            )
+            name = self.name
+
+            def emit(n):
+                if isinstance(n, tuple):
+                    n = sum(int(c) for c in n)
+                telemetry.execute(
+                    telemetry.SYNC_DONE,
+                    {"keys_updated_count": int(n)},
+                    {"name": name},
+                )
+            if self._telemetry_defer is not None:
+                # mid-drain: park the readback; process_pending flushes
+                # after every group has dispatched (same events, same
+                # per-replica order, no pipeline stall)
+                self._telemetry_defer.append((count_fn, emit))
+            else:
+                emit(count_fn())
 
     def _emit_diffs(
         self,
@@ -1403,10 +1485,18 @@ class Replica:
             self._flush()
             self._monitor_neighbours()
             self._push_deltas()
+            opened = 0
             for n in list(self._monitors):
                 if n == self.addr:
                     continue
-                self._open_walk(n)
+                opened += bool(self._open_walk(n))
+            if opened:
+                self._flight("sync_open", peers=opened, seq=self._seq)
+                if self._lag is not None:
+                    # the origin's propagation-round clock: one round per
+                    # tick that actually opened walks (lag samples report
+                    # how many of these they waited through)
+                    self._lag.note_round(self.addr)
 
     def _open_walk(self, n) -> bool:
         """Open one digest-walk round toward ``n`` (the classic
@@ -1837,6 +1927,9 @@ class Replica:
             logger.debug(
                 "delta push from %r gapped; requesting full rows", msg.frm
             )
+            self._flight(
+                "gap_repair", peer=str(msg.frm), buckets=int(len(msg.buckets))
+            )
             self.transport.send(
                 msg.frm,
                 sync_proto.GetDiffMsg(
@@ -1867,26 +1960,35 @@ class Replica:
             # dot-level changed count (may count a key twice when a merge
             # both inserts a winner and kills a superseded entry — a
             # documented approximation of the reference's per-key diff count)
-            self._note_state_changed(lambda: int(res.n_inserted) + int(res.n_killed))
-        telemetry.execute(
-            telemetry.SYNC_ROUND,
-            {
-                "duration_s": time.perf_counter() - t0,
-                "buckets": int(len(msg.buckets)),
-                # one payload per alive dot in the slice (_slice_wire
-                # builds the dict from np.nonzero(alive)), so this counts
-                # shipped entries from host data — the device-plane alive
-                # column is never reduced/read back just for telemetry
-                "entries": len(msg.payloads),
-            },
-            {
-                "name": self.name,
-                # which data plane carried the slice (observability for
-                # mixed-plane clusters); metadata, not measurements —
-                # measurements stay numeric/aggregatable
-                "plane": "host" if isinstance(a["key"], np.ndarray) else "device",
-            },
+            self._note_state_changed(
+            # default-arg capture of JUST the two count scalars: a
+            # closure over ``res`` parks the whole MergeRowsResult —
+            # including ``res.state`` — in the drain's deferral
+            # window, pinning every superseded store generation and
+            # defeating XLA's input-buffer reuse on each subsequent
+            # merge (a full-store copy per dispatch)
+            lambda ins=res.n_inserted, kill=res.n_killed: (ins, kill)
         )
+        if telemetry.has_handlers(telemetry.SYNC_ROUND):
+            telemetry.execute(
+                telemetry.SYNC_ROUND,
+                {
+                    "duration_s": time.perf_counter() - t0,
+                    "buckets": int(len(msg.buckets)),
+                    # one payload per alive dot in the slice (_slice_wire
+                    # builds the dict from np.nonzero(alive)), so this counts
+                    # shipped entries from host data — the device-plane alive
+                    # column is never reduced/read back just for telemetry
+                    "entries": len(msg.payloads),
+                },
+                {
+                    "name": self.name,
+                    # which data plane carried the slice (observability for
+                    # mixed-plane clusters); metadata, not measurements —
+                    # measurements stay numeric/aggregatable
+                    "plane": "host" if isinstance(a["key"], np.ndarray) else "device",
+                },
+            )
         self._durable(
             lambda: {
                 "kind": "entries",
@@ -1946,6 +2048,14 @@ class Replica:
         d = self._applied_seq
         cur = d.pop(peer, 0)  # pop+reinsert: insertion order ≈ recency
         d[peer] = max(cur, int(seq))
+        if self._lag is not None and d[peer] > cur:
+            # dot-provenance lag trace, zero wire changes: the watermark
+            # advance is keyed on fields already on the wire (the
+            # originator address + seq of the round opener / log chunk),
+            # so every sampled commit of `peer` at-or-below it is now
+            # visible HERE — the per-(origin, peer) convergence-lag and
+            # propagation-round histograms fill from exactly this event
+            self._lag.note_visible(self.addr, peer, d[peer])
         while len(d) > self.MAX_PEER_WATERMARKS:
             d.pop(next(iter(d)))
         floor = self._catchup_walk_floor
@@ -1972,6 +2082,7 @@ class Replica:
             frm=self.addr, to=peer, last_seq=last, applied_seq=last
         )
         if self.transport.send(peer, msg):
+            self._flight("catchup_request", peer=str(peer), last_seq=last)
             self._catchup[peer] = {
                 "t0": now,
                 "expiry": now + self.sync_timeout,
@@ -2274,15 +2385,20 @@ class Replica:
             if current:
                 dur = time.monotonic() - st["t0"]
                 self._catchup_last_duration = dur
-                telemetry.execute(
-                    telemetry.CATCHUP_DONE,
-                    {
-                        "chunks": st["chunks"] + 1,
-                        "duration_s": dur,
-                        "horizon_fallback": int(st["horizon"]),
-                    },
-                    {"name": self.name, "peer": peer},
+                self._flight(
+                    "catchup_done", peer=str(peer), chunks=st["chunks"] + 1,
+                    horizon_fallback=bool(st["horizon"]),
                 )
+                if telemetry.has_handlers(telemetry.CATCHUP_DONE):
+                    telemetry.execute(
+                        telemetry.CATCHUP_DONE,
+                        {
+                            "chunks": st["chunks"] + 1,
+                            "duration_s": dur,
+                            "horizon_fallback": int(st["horizon"]),
+                        },
+                        {"name": self.name, "peer": peer},
+                    )
                 if not st["horizon"]:
                     # an unclamped stream covered everything up to the
                     # server's seq_hi ≥ its round-open seq — exactly
@@ -2416,6 +2532,9 @@ class Replica:
                 # gap means the mask lied — full per-slice is the only
                 # safe answer then.
                 self._ingress_gap_partitions += 1
+                self._flight(
+                    "gap_partition", depth=len(msgs), gapped=len(gapped)
+                )
                 clean = [m for i, m in enumerate(msgs) if i not in gapped]
                 self._handle_entries_group(clean, partition=False)
                 for i in sorted(gapped):
@@ -2427,6 +2546,7 @@ class Replica:
             # the gapped sources and answers each with the GetDiffMsg
             # repair exactly as sequential handling would
             self._ingress_gap_fallbacks += 1
+            self._flight("gap_fallback", depth=len(msgs))
             for m in msgs:
                 self._count_dispatch(1, 1)
                 self._handle_entries(m)
@@ -2443,7 +2563,15 @@ class Replica:
         self._commit_entries_group(
             msgs,
             offsets,
-            lambda: jax.device_get((res.n_ins_row, res.n_kill_row)),
+            # raw device arrays: the consumer transfers them (batched
+            # with every other parked readback when inside a drain).
+            # Default-arg capture of JUST the two count arrays: closing
+            # over ``res`` would park the whole MergeRowsResult —
+            # including ``res.state`` — in the deferral window, pinning
+            # every superseded store generation and defeating XLA's
+            # input-buffer reuse on each subsequent merge (a full-store
+            # copy per dispatch, ~40% of ingest wall time at depth 64)
+            lambda ins=res.n_ins_row, kill=res.n_kill_row: (ins, kill),
             dt,
         )
         if telemetry.has_handlers(telemetry.INGEST_COALESCE):
@@ -2467,35 +2595,65 @@ class Replica:
         SYNC_ROUND streams, and WAL record bytes cannot drift between
         them (the fleet-vs-solo bit-for-bit parity contract).
         ``counts_fn`` lazily yields the kernel's per-row (insert, kill)
-        count arrays — a device readback only SYNC_DONE handlers pay
-        for. Caller holds the lock, has stored the merged state, and
-        has invalidated the tree/read caches."""
+        count arrays, device or host — a readback only SYNC_DONE
+        handlers pay for (batched with the drain pass's other parked
+        readbacks when one is active). Caller holds the lock, has
+        stored the merged state, and has invalidated the tree/read
+        caches."""
         depth = len(msgs)
         want_done = telemetry.has_handlers(telemetry.SYNC_DONE)
+        want_round = telemetry.has_handlers(telemetry.SYNC_ROUND)
         if want_done:
-            ins_row, kill_row = counts_fn()
-        for i, m in enumerate(msgs):
-            self._seq += 1
-            if want_done:
-                lo, hi = offsets[i]
-                telemetry.execute(
-                    telemetry.SYNC_DONE,
-                    {
-                        "keys_updated_count": int(
-                            ins_row[lo:hi].sum() + kill_row[lo:hi].sum()
-                        )
-                    },
-                    {"name": self.name},
+            name = self.name
+
+            def emit_done(counts, offsets=offsets, depth=depth):
+                ins_row, kill_row = counts
+                # one vectorised prefix sum, then O(1) per message —
+                # per-message ``[lo:hi].sum()`` slices cost more than
+                # the bridge's whole handler chain at coalesce depth 16
+                tot = np.cumsum(
+                    np.asarray(ins_row, np.int64) + np.asarray(kill_row, np.int64)
                 )
-            telemetry.execute(
+                meas: list = []
+                for lo, hi in offsets[:depth]:
+                    if hi > lo:
+                        n = int(tot[hi - 1]) - (int(tot[lo - 1]) if lo else 0)
+                    else:
+                        n = 0  # empty member slice
+                    meas.append({"keys_updated_count": n})
+                # one batch emission: plain handlers still see the exact
+                # per-message stream; the bridge folds it in one call
+                telemetry.execute_many(
+                    telemetry.SYNC_DONE, meas, {"name": name}
+                )
+
+            if self._telemetry_defer is not None:
+                # mid-drain: the per-row accounting readback waits until
+                # every group in this drain pass has dispatched (the
+                # per-message SYNC_DONE stream is emitted then, in order,
+                # off ONE batched transfer)
+                self._telemetry_defer.append((counts_fn, emit_done))
+            else:
+                emit_done(counts_fn())
+        if want_round:
+            # one batch emission for the whole group (shared meta, the
+            # per-slice duration split evenly): plain handlers still see
+            # the per-message stream; the bridge folds it in one call
+            per_msg_dt = dt / depth
+            telemetry.execute_many(
                 telemetry.SYNC_ROUND,
-                {
-                    "duration_s": dt / depth,
-                    "buckets": int(len(m.buckets)),
-                    "entries": len(m.payloads),
-                },
+                [
+                    {
+                        "duration_s": per_msg_dt,
+                        "buckets": int(len(m.buckets)),
+                        "entries": len(m.payloads),
+                    }
+                    for m in msgs
+                ],
                 {"name": self.name, "plane": "host"},
             )
+        for m in msgs:
+            self._seq += 1
             a, payloads = m.arrays, m.payloads
             self._durable(
                 lambda a=a, payloads=payloads: {
@@ -2550,6 +2708,7 @@ class Replica:
         behave exactly as without a fleet."""
         with self._lock:
             self._fleet_fallbacks += 1
+            self._flight("fleet_fallback", depth=len(msgs))
             self._handle_entries_group(msgs)
 
     def fleet_commit(
@@ -2702,18 +2861,42 @@ class Replica:
         set the wake event, so the loop re-enters without sleeping and
         drains the remainder next iteration."""
         drain = getattr(self.transport, "drain_nowait", None)
+        obs = self._obs
+        t0 = time.perf_counter() if obs is not None else 0.0
         n = 0
-        for _ in range(8):
-            if drain is not None:
-                batch = drain(self.addr, self.ingress_batch)
-            else:  # transports predating the batch-receive API
-                batch = self.transport.drain(self.addr)
-            if not batch:
-                return n
-            n += len(batch)
-            self._handle_batch(batch)
-            if drain is None or len(batch) < self.ingress_batch:
-                return n
+        with self._lock:
+            # open a SYNC_DONE deferral window for this drain pass (see
+            # _telemetry_defer): nested/concurrent passes reuse the
+            # outermost window, which owns the flush
+            top = self._telemetry_defer is None
+            if top:
+                self._telemetry_defer = []
+        try:
+            for _ in range(8):
+                if drain is not None:
+                    batch = drain(self.addr, self.ingress_batch)
+                else:  # transports predating the batch-receive API
+                    batch = self.transport.drain(self.addr)
+                if not batch:
+                    break
+                n += len(batch)
+                self._handle_batch(batch)
+                if drain is None or len(batch) < self.ingress_batch:
+                    break
+        finally:
+            if top:
+                with self._lock:
+                    deferred, self._telemetry_defer = self._telemetry_defer, None
+                if deferred:
+                    # ONE transfer for every parked accounting pytree
+                    # (device_get passes already-host values through)
+                    fetched = jax.device_get([f() for f, _e in deferred])
+                    for (_f, emit), data in zip(deferred, fetched):
+                        emit(data)
+        if obs is not None and n:
+            # drain-lag accounting: one registry update per drain PASS
+            # (never per message — the hot path stays amortised)
+            obs.record_drain(self.name, n, time.perf_counter() - t0)
         return n
 
     def _handle_batch(self, msgs: list) -> None:
@@ -2821,6 +3004,50 @@ class Replica:
                 }
             return out
 
+    # -- observability plane sources (ISSUE 9) ---------------------------
+
+    def wal_size_bytes(self) -> int:
+        """On-disk WAL footprint (segments + staged append buffer);
+        0 without a WAL. Scrape-time observability — never on a hot path."""
+        with self._lock:
+            if self._wal is None:
+                return 0
+            return self._wal.size_bytes()
+
+    def obs_varz(self) -> dict:
+        """This replica's ``/varz`` stanza: the UNCHANGED :meth:`stats`
+        dict under a typed envelope (the additive-surface contract,
+        MIGRATING.md)."""
+        out = {"kind": "replica", "stats": self.stats()}
+        if self.flight is not None:
+            out["flight_events"] = self.flight.events_recorded()
+        return out
+
+    def health(self) -> dict:
+        """Liveness/readiness for ``/healthz``: the event loop is
+        responsive (fresh heartbeat when threaded; fleet members are
+        covered by the fleet's tick check), the WAL directory is
+        writable, and every configured neighbour is reachable per the
+        existing monitor/heartbeat state (an unmonitorable neighbour is
+        exactly what the transport's Down/ping machinery reported dead)."""
+        with self._lock:
+            loop_ok = True
+            if self._thread is not None:
+                loop_ok = self._thread.is_alive() and (
+                    time.monotonic() - self._loop_ts
+                    < max(5 * self.sync_interval, 2.0)
+                )
+            wal_ok = self._wal is None or os.access(self._wal.directory, os.W_OK)
+            neighbours = [n for n in self._neighbours if n != self.addr]
+            unreachable = [n for n in neighbours if n not in self._monitors]
+        return {
+            "ok": loop_ok and wal_ok and not unreachable,
+            "loop_responsive": loop_ok,
+            "wal_writable": wal_ok,
+            "neighbours": len(neighbours),
+            "neighbours_unreachable": [str(n) for n in unreachable],
+        }
+
     def start(self) -> "Replica":
         """Run the periodic anti-entropy loop in a background thread
         (reference: ``send_after(self(), :sync, interval)``,
@@ -2840,6 +3067,9 @@ class Replica:
             while not self._stop.is_set():
                 self.process_pending()
                 with self._lock:
+                    # health heartbeat: a wedged loop (stuck merge, dead
+                    # thread) goes stale and /healthz flips unready
+                    self._loop_ts = time.monotonic()
                     if self._pending:
                         self._flush()
                 now = time.monotonic()
@@ -2887,6 +3117,12 @@ class Replica:
             self._wake.set()
             self._thread.join(timeout=5)
             self._thread = None
+        if self.flight is not None:
+            # the black box: a crashing replica's recent structured
+            # events go out through the logger for the post-mortem
+            self.flight.dump()
+        if self._obs is not None:
+            self._obs.unregister_replica(self)
         with self._lock:
             # under the replica lock: WalLog is not thread-safe by
             # itself, and a concurrent mutate() mid-append must not race
@@ -2908,6 +3144,9 @@ class Replica:
             self._wake.set()
             self._thread.join(timeout=5)
             self._thread = None
+        if self._obs is not None:
+            # a stopped replica must not scrape as a stale last value
+            self._obs.unregister_replica(self)
         try:
             self.sync_to_all()
         except Exception:  # best-effort, like the reference's TODO-marked path

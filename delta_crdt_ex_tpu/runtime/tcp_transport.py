@@ -259,7 +259,10 @@ class _SenderConn:
     #: idempotent and the periodic sync re-covers)
     QUEUE_MAX_BYTES = 64 << 20
 
-    def __init__(self, sock: socket.socket, on_dead, accepts_z: bool = False) -> None:
+    def __init__(
+        self, sock: socket.socket, on_dead, accepts_z: bool = False,
+        on_sent=None,
+    ) -> None:
         self.sock = sock
         #: negotiated via HELLO: whether this peer accepts _MSGZ frames
         self.accepts_z = accepts_z
@@ -268,6 +271,9 @@ class _SenderConn:
         self._q_bytes = 0  # approximate: adjusted under _dead_lock only
         self._q: queue.Queue = queue.Queue(maxsize=self.QUEUE_MAX)
         self._on_dead = on_dead
+        #: wire-byte accounting callback (observability plane): called
+        #: with each frame's on-wire size AFTER a successful send
+        self._on_sent = on_sent
         self._dead = False
         self._dead_lock = threading.Lock()
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -289,6 +295,11 @@ class _SenderConn:
             except queue.Full:
                 return False  # dropped; periodic sync will retry
 
+    def queued_bytes(self) -> int:
+        """Bytes currently queued on this connection (scrape-time)."""
+        with self._dead_lock:
+            return self._q_bytes
+
     def close(self) -> None:
         try:
             self._q.put_nowait(None)
@@ -308,6 +319,8 @@ class _SenderConn:
                 self._q_bytes -= len(item[1])
             try:
                 _send_frame(self.sock, item[0], item[1])
+                if self._on_sent is not None:
+                    self._on_sent(len(item[1]) + 5)  # + length word + kind
             except OSError:
                 # hand the failed frame and the rest of the queue back to
                 # the transport: a stale pooled conn (peer restarted) must
@@ -355,6 +368,15 @@ class TcpTransport:
         self._conns: dict[tuple, _SenderConn] = {}
         self._hb_conns: dict[tuple, socket.socket] = {}  # persistent ping conns
         self.heartbeat_interval = heartbeat_interval
+        #: wire-byte accounting (observability plane): written by the
+        #: per-connection sender threads / per-connection serve threads,
+        #: read by :meth:`transport_stats` at scrape time. A dedicated
+        #: lock (crdtlint RACE001) — bumping an int per frame on the
+        #: transport-wide lock would contend with register/send/drain
+        #: on every frame of every connection
+        self._bytes_lock = threading.Lock()
+        self._tx_bytes = 0
+        self._rx_bytes = 0
         self._stop = threading.Event()
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -484,7 +506,7 @@ class TcpTransport:
                             k, p = _MSG, pickle.dumps(_decode_msgb(p), protocol=4)
                         fresh.enqueue(k, p, attempt=1)
 
-        conn = _SenderConn(sock, on_dead)
+        conn = _SenderConn(sock, on_dead, on_sent=self._count_tx)
         _start_hello_negotiation(conn)
         with self._lock:
             if self._stop.is_set():
@@ -523,6 +545,32 @@ class TcpTransport:
             if len(z) < 0.9 * len(payload):  # keep incompressible frames raw
                 payload, kind = z, _MSGZ
         return conn.enqueue(kind, payload)
+
+    def _count_tx(self, n: int) -> None:
+        with self._bytes_lock:
+            self._tx_bytes += n
+
+    def queue_depth(self, addr: Hashable) -> int:
+        """Queued messages in one LOCAL mailbox (the observability
+        plane's mailbox-depth gauge; same contract as LocalTransport)."""
+        with self._lock:
+            mb = self._mailboxes.get(self._local_name(addr))
+        return mb.qsize() if mb is not None else 0
+
+    def transport_stats(self) -> dict:
+        """Scrape-time wire accounting: bytes sent/received over TCP and
+        bytes queued on sender connections (the backpressure signal —
+        ``QUEUE_MAX_BYTES`` drops begin when a peer's queue fills)."""
+        with self._bytes_lock:
+            tx, rx = self._tx_bytes, self._rx_bytes
+        with self._lock:
+            conns = list(self._conns.values())
+        return {
+            "endpoint": f"{self.host}:{self.port}",
+            "tx_bytes": tx,
+            "rx_bytes": rx,
+            "queue_bytes": sum(c.queued_bytes() for c in conns),
+        }
 
     @staticmethod
     def _ping_roundtrip(sock: socket.socket) -> bool:
@@ -633,6 +681,8 @@ class TcpTransport:
                 if frame is None:
                     return
                 kind, payload = frame
+                with self._bytes_lock:
+                    self._rx_bytes += len(payload) + 5
                 if kind == _PING:
                     try:
                         _send_frame(conn, _PONG, b"")

@@ -24,19 +24,42 @@ CATCHUP_CHUNK = ("delta_crdt", "catchup", "chunk")  # measurements: records, row
 CATCHUP_DONE = ("delta_crdt", "catchup", "done")  # measurements: chunks, duration_s, horizon_fallback; metadata: name, peer
 FLEET_DISPATCH = ("delta_crdt", "fleet", "dispatch")  # measurements: replicas, lanes, messages, rows, padded_rows, duration_s; metadata: fleet
 
+def declared_events() -> tuple[tuple, ...]:
+    """Every event tuple this module declares (the OBS001 contract:
+    each must have ≥1 emission site and a metrics-bridge subscription
+    row — crdtlint OBS001 enforces both statically, the bridge warns
+    about missing rows at attach time, and ``tests/test_metrics.py``
+    pins full table coverage)."""
+    return tuple(
+        v
+        for k, v in sorted(globals().items())
+        if k.isupper()
+        and isinstance(v, tuple)
+        and v
+        and all(isinstance(p, str) for p in v)
+    )
+
+
 _lock = threading.Lock()
-_handlers: dict[tuple, list[Callable]] = defaultdict(list)
+#: event -> handler tuple. Handler tables are REPLACED, never mutated
+#: in place (copy-on-write under ``_lock``), so ``execute`` can iterate
+#: the tuple it read without copying it first — one hot-path
+#: allocation per event gone, and a concurrent attach/detach never
+#: mutates a tuple an ``execute`` is mid-iteration over.
+_handlers: dict[tuple, tuple[Callable, ...]] = defaultdict(tuple)
 
 
 def attach(event: tuple, handler: Callable[[tuple, dict, dict], None]) -> None:
     with _lock:
-        _handlers[event].append(handler)
+        _handlers[event] = _handlers[event] + (handler,)
 
 
 def detach(event: tuple, handler: Callable) -> None:
     with _lock:
-        if handler in _handlers.get(event, []):
-            _handlers[event].remove(handler)
+        table = _handlers.get(event, ())
+        if handler in table:
+            i = table.index(handler)  # first occurrence, like list.remove
+            _handlers[event] = table[:i] + table[i + 1:]
 
 
 def has_handlers(event: tuple) -> bool:
@@ -46,6 +69,29 @@ def has_handlers(event: tuple) -> bool:
 
 def execute(event: tuple, measurements: dict, metadata: dict) -> None:
     with _lock:
-        handlers = list(_handlers.get(event, []))
+        handlers = _handlers.get(event, ())
     for h in handlers:
         h(event, measurements, metadata)
+
+
+def execute_many(event: tuple, measurements_list: list, metadata: dict) -> None:
+    """Emit one event per element of ``measurements_list`` (shared
+    ``metadata``), preserving order — the batch form the grouped ingest
+    path uses where it already holds a natural batch.
+
+    A plain handler observes the EXACT per-message stream ``execute``
+    in a loop would deliver (the parity contracts over SYNC_DONE /
+    SYNC_ROUND streams hold verbatim). A handler carrying a ``batch``
+    attribute (the metrics bridge: one registry-lock acquire and one
+    label resolve for the whole batch, instead of per message —
+    per-message handler dispatch is the dominant enabled-telemetry cost
+    at coalesce depth 16) consumes the whole list in one call."""
+    with _lock:
+        handlers = _handlers.get(event, ())
+    for h in handlers:
+        batch = getattr(h, "batch", None)
+        if batch is not None:
+            batch(event, measurements_list, metadata)
+        else:
+            for meas in measurements_list:
+                h(event, meas, metadata)

@@ -100,6 +100,14 @@ class LocalTransport:
         with self._lock:
             self._monitors.get(target, set()).discard(watcher)
 
+    def queue_depth(self, addr: Hashable) -> int:
+        """Queued messages in one mailbox — the mailbox-depth gauge the
+        observability plane polls at scrape time (``qsize`` is approximate
+        under concurrency, which is exactly what a gauge is for)."""
+        with self._lock:
+            mb = self._mailboxes.get(addr)
+        return mb.qsize() if mb is not None else 0
+
     # -- driving (deterministic mode) ------------------------------------
 
     def drain_nowait(self, addr: Hashable, max_n: int | None = None) -> list:

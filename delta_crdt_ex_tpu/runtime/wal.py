@@ -526,6 +526,18 @@ class WalLog:
         tests)."""
         return [s.path for s in self._scan_segments()]
 
+    def size_bytes(self) -> int:
+        """On-disk footprint of every retained segment plus the staged
+        (uncommitted) append buffer — the ``crdt_wal_bytes`` gauge the
+        observability plane polls at scrape time (ISSUE 9)."""
+        total = len(self._buf)
+        for seg in self._scan_segments():
+            try:
+                total += os.path.getsize(seg.path)
+            except OSError:
+                pass  # compaction may race a scrape; a gone segment is 0
+        return total
+
 
 class ReplayClock:
     """Re-issues the exact LWW timestamps a logged batch minted, so

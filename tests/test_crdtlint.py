@@ -2352,7 +2352,7 @@ def test_mutation_unlocked_telemetry_handler_table_is_caught():
     anchor = (
         "def attach(event: tuple, handler: Callable[[tuple, dict, dict], None]) -> None:\n"
         "    with _lock:\n"
-        "        _handlers[event].append(handler)\n"
+        "        _handlers[event] = _handlers[event] + (handler,)\n"
     )
     assert anchor in (REPO_ROOT / rel).read_text()
     new = _overlay_lint(
@@ -2360,7 +2360,7 @@ def test_mutation_unlocked_telemetry_handler_table_is_caught():
         lambda s: s.replace(
             anchor,
             "def attach(event: tuple, handler: Callable[[tuple, dict, dict], None]) -> None:\n"
-            "    _handlers[event].append(handler)\n",
+            "    _handlers[event] = _handlers[event] + (handler,)\n",
             1,
         ),
     )
@@ -2424,3 +2424,231 @@ def test_race_snapshot_builtin_reports_race005_only(tmp_path):
     found = lint(pkg)
     assert rules_of(found) == {"RACE005"}
     assert len([f for f in found if "_items" in f.message]) == 1
+
+
+# ----------------------------------------------------------------------
+# OBS001/OBS002 — observability-plane coverage + hot-path guards
+
+
+OBS_PKG = {
+    "runtime/telemetry.py": """
+        SYNC_DONE = ("pkg", "sync", "done")
+        WAL_FLUSH = ("pkg", "wal", "flush")
+
+        def has_handlers(event):
+            return False
+
+        def execute(event, measurements, metadata):
+            pass
+    """,
+    "runtime/replica.py": """
+        from fixpkg.runtime import telemetry
+
+        class Replica:
+            def merge(self):
+                if telemetry.has_handlers(telemetry.SYNC_DONE):
+                    telemetry.execute(telemetry.SYNC_DONE, {"n": 1}, {})
+
+            def flush(self):
+                want = telemetry.has_handlers(telemetry.WAL_FLUSH)
+                if want:
+                    telemetry.execute(telemetry.WAL_FLUSH, {"b": 2}, {})
+    """,
+    "runtime/metrics.py": """
+        from fixpkg.runtime import telemetry
+
+        class Bridge:
+            def _on_done(self, e, m, meta):
+                pass
+
+            def _on_flush(self, e, m, meta):
+                pass
+
+            def _table(self):
+                return [
+                    (telemetry.SYNC_DONE, self._on_done),
+                    (telemetry.WAL_FLUSH, self._on_flush),
+                ]
+    """,
+}
+
+
+def test_obs_clean_fixture(tmp_path):
+    pkg = make_pkg(tmp_path, OBS_PKG)
+    assert [f for f in lint(pkg) if f.rule.startswith("OBS")] == []
+
+
+def test_obs001_unemitted_event_flagged(tmp_path):
+    mods = dict(OBS_PKG)
+    mods["runtime/telemetry.py"] = (
+        OBS_PKG["runtime/telemetry.py"]
+        + '\n        GHOST = ("pkg", "ghost", "x")\n'
+    )
+    mods["runtime/metrics.py"] = OBS_PKG["runtime/metrics.py"].replace(
+        "(telemetry.WAL_FLUSH, self._on_flush),",
+        "(telemetry.WAL_FLUSH, self._on_flush),\n"
+        "                    (telemetry.GHOST, self._on_flush),",
+    )
+    found = [f for f in lint(make_pkg(tmp_path, mods)) if f.rule == "OBS001"]
+    assert len(found) == 1 and "never emitted" in found[0].message
+
+
+def test_obs001_unbridged_event_flagged(tmp_path):
+    mods = dict(OBS_PKG)
+    mods["runtime/metrics.py"] = OBS_PKG["runtime/metrics.py"].replace(
+        "                    (telemetry.WAL_FLUSH, self._on_flush),\n", ""
+    )
+    found = [f for f in lint(make_pkg(tmp_path, mods)) if f.rule == "OBS001"]
+    assert len(found) == 1
+    assert "WAL_FLUSH" in found[0].message and "bridge" in found[0].message
+
+
+def test_obs001_missing_bridge_table_flagged(tmp_path):
+    mods = dict(OBS_PKG)
+    mods["runtime/metrics.py"] = "from fixpkg.runtime import telemetry\n"
+    found = [f for f in lint(make_pkg(tmp_path, mods)) if f.rule == "OBS001"]
+    assert any("no metrics-bridge subscription table" in f.message for f in found)
+
+
+def test_obs002_unguarded_hot_execute_flagged(tmp_path):
+    mods = dict(OBS_PKG)
+    mods["runtime/replica.py"] = """
+        from fixpkg.runtime import telemetry
+
+        class Replica:
+            def merge(self):
+                telemetry.execute(telemetry.SYNC_DONE, {"n": 1}, {})
+    """
+    found = [f for f in lint(make_pkg(tmp_path, mods)) if f.rule == "OBS002"]
+    assert len(found) == 1 and "SYNC_DONE" in found[0].message
+
+
+def test_obs002_cold_module_execute_clean(tmp_path):
+    """Unguarded execute OUTSIDE the hot module set (e.g. a storage
+    module) is fine — the guard discipline is a hot-path contract."""
+    mods = dict(OBS_PKG)
+    mods["runtime/storage.py"] = """
+        from fixpkg.runtime import telemetry
+
+        def persist():
+            telemetry.execute(telemetry.WAL_FLUSH, {"b": 1}, {})
+    """
+    assert [f for f in lint(make_pkg(tmp_path, mods)) if f.rule.startswith("OBS")] == []
+
+
+def test_obs002_hoisted_guard_clean(tmp_path):
+    """`want = telemetry.has_handlers(E)` ... `if want:` is a guard."""
+    pkg = make_pkg(tmp_path, OBS_PKG)
+    assert [f for f in lint(pkg) if f.rule == "OBS002"] == []
+
+
+def test_obs002_guarded_closure_clean(tmp_path):
+    """A nested def whose *definition* sits under a has_handlers guard
+    inherits the guarded state — the deferred-emission idiom (the
+    closure is parked and called later, but only ever created under
+    the guard)."""
+    mods = dict(OBS_PKG)
+    mods["runtime/replica.py"] = """
+        from fixpkg.runtime import telemetry
+
+        class Replica:
+            def merge(self):
+                want = telemetry.has_handlers(telemetry.SYNC_DONE)
+                if want:
+                    def emit(n):
+                        telemetry.execute(telemetry.SYNC_DONE, {"n": n}, {})
+                    self._defer = emit
+
+            def flush(self):
+                if telemetry.has_handlers(telemetry.WAL_FLUSH):
+                    telemetry.execute(telemetry.WAL_FLUSH, {"b": 2}, {})
+    """
+    assert [f for f in lint(make_pkg(tmp_path, mods)) if f.rule == "OBS002"] == []
+
+
+def test_obs_execute_many_counts_as_emission_and_needs_guard(tmp_path):
+    """``telemetry.execute_many`` is an emission site for OBS001 (an
+    event emitted ONLY through the batch form is not a dead contract)
+    and is held to the same OBS002 guard discipline."""
+    mods = dict(OBS_PKG)
+    mods["runtime/replica.py"] = """
+        from fixpkg.runtime import telemetry
+
+        class Replica:
+            def merge(self):
+                if telemetry.has_handlers(telemetry.SYNC_DONE):
+                    telemetry.execute_many(
+                        telemetry.SYNC_DONE, [{"n": 1}, {"n": 2}], {}
+                    )
+
+            def flush(self):
+                want = telemetry.has_handlers(telemetry.WAL_FLUSH)
+                if want:
+                    telemetry.execute(telemetry.WAL_FLUSH, {"b": 2}, {})
+    """
+    assert [f for f in lint(make_pkg(tmp_path, mods)) if f.rule.startswith("OBS")] == []
+    # strip the guard: the batch form is red exactly like execute
+    mods["runtime/replica.py"] = mods["runtime/replica.py"].replace(
+        "if telemetry.has_handlers(telemetry.SYNC_DONE):\n                    telemetry.execute_many(",
+        "telemetry.execute_many(",
+    )
+    red = tmp_path / "red"
+    red.mkdir()
+    found = [f for f in lint(make_pkg(red, mods)) if f.rule == "OBS002"]
+    assert len(found) == 1 and "SYNC_DONE" in found[0].message
+
+
+def test_obs002_unguarded_closure_flagged(tmp_path):
+    """A nested def defined OUTSIDE any guard is no excuse — its
+    execute is still red, and the finding names the closure."""
+    mods = dict(OBS_PKG)
+    mods["runtime/replica.py"] = """
+        from fixpkg.runtime import telemetry
+
+        class Replica:
+            def merge(self):
+                def emit(n):
+                    telemetry.execute(telemetry.SYNC_DONE, {"n": n}, {})
+                emit(1)
+                if telemetry.has_handlers(telemetry.WAL_FLUSH):
+                    telemetry.execute(telemetry.WAL_FLUSH, {"b": 2}, {})
+    """
+    found = [f for f in lint(make_pkg(tmp_path, mods)) if f.rule == "OBS002"]
+    assert len(found) == 1 and "SYNC_DONE" in found[0].message
+    assert "Replica.merge.emit" in found[0].message
+
+
+def test_mutation_dropped_bridge_row_is_caught():
+    """ISSUE 9 acceptance: deleting one subscription row from the REAL
+    metrics bridge turns the gate red (OBS001)."""
+    rel = f"{PKG}/runtime/metrics.py"
+    row = "            (telemetry.CATCHUP_DONE, self._on_catchup_done),\n"
+    assert row in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(rel, lambda s: s.replace(row, ""))
+    assert any(
+        f.rule == "OBS001" and "CATCHUP_DONE" in f.message for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_unguarded_hot_emission_is_caught():
+    """ISSUE 9 acceptance: stripping a has_handlers guard off a
+    hot-path emission in the REAL replica turns the gate red (OBS002)."""
+    rel = f"{PKG}/runtime/replica.py"
+    guard = "if telemetry.has_handlers(telemetry.SYNC_ROUND):"
+    assert guard in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(rel, lambda s: s.replace(guard, "if True:", 1))
+    assert any(
+        f.rule == "OBS002" and "SYNC_ROUND" in f.message for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_declared_unemitted_event_is_caught():
+    """A declared-but-dead event tuple in the REAL telemetry module is
+    a red OBS001 (both legs: unemitted and unbridged)."""
+    rel = f"{PKG}/runtime/telemetry.py"
+    new = _overlay_lint(
+        rel, lambda s: s + '\nGHOST_EVENT = ("delta_crdt", "ghost", "x")\n'
+    )
+    msgs = [f.message for f in new if f.rule == "OBS001"]
+    assert any("never emitted" in m for m in msgs)
+    assert any("bridge" in m for m in msgs)

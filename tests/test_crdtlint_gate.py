@@ -49,11 +49,11 @@ def test_gate_covers_every_catalogued_family():
     for family in ("LOCK001", "LOCK002", "LOCK003", "RACE001", "RACE002",
                    "RACE003", "RACE004", "RACE005", "SYNC001", "PURE001",
                    "DONATE001", "WIRE001", "WIRE005", "WAL001", "WAL002",
-                   "SUPPRESS001", "SUPPRESS002"):
+                   "OBS001", "OBS002", "SUPPRESS001", "SUPPRESS002"):
         assert family in catalogued
     # every registered checker's module exports at least one catalogued
     # rule id (wiring smoke, not a bijection)
-    assert len(ALL_RULES) >= 8
+    assert len(ALL_RULES) >= 9
 
 
 def test_full_suite_wall_clock_budget():

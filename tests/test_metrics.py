@@ -1,0 +1,487 @@
+"""Observability plane unit tests (ISSUE 9): registry semantics +
+Prometheus exposition, the telemetry→metrics bridge's full-coverage
+contract, the flight-recorder ring, and the dot-provenance lag tracer's
+sampling/matching math (deterministic `now` injection throughout)."""
+
+from __future__ import annotations
+
+import re
+
+import pytest
+
+from delta_crdt_ex_tpu.runtime import telemetry
+from delta_crdt_ex_tpu.runtime.metrics import (
+    COUNT_BUCKETS,
+    FlightRecorder,
+    LagTracer,
+    MetricsBridge,
+    Observability,
+    Registry,
+    default_observability,
+    resolve_obs,
+)
+
+@pytest.fixture(autouse=True)
+def _isolated_telemetry_handlers():
+    """Earlier suites attach throwaway telemetry handlers and never
+    detach (harmless for them, fatal for assertions about the
+    process-global table here): run every test in this module against
+    a clean table, and leave it clean."""
+    with telemetry._lock:
+        telemetry._handlers.clear()
+    yield
+    with telemetry._lock:
+        telemetry._handlers.clear()
+
+
+# ----------------------------------------------------------------------
+# registry + metric families
+
+
+def test_counter_semantics():
+    reg = Registry()
+    c = reg.counter("crdt_test_total", "help", ("name",))
+    c.inc(1, ("a",))
+    c.inc(2.5, ("a",))
+    c.inc(7, ("b",))
+    assert c.value(("a",)) == 3.5
+    assert c.value(("b",)) == 7
+    assert c.value(("missing",)) == 0.0
+    with pytest.raises(ValueError):
+        c.inc(-1, ("a",))
+
+
+def test_gauge_set_inc_remove():
+    reg = Registry()
+    g = reg.gauge("crdt_g", "help", ("name",))
+    g.set(5, ("x",))
+    g.inc(2, ("x",))
+    assert g.value(("x",)) == 7
+    g.remove(("x",))
+    assert g.value(("x",)) == 0.0
+    assert "crdt_g" not in reg.render()  # no samples -> family omitted
+
+
+def test_histogram_buckets_cumulative():
+    reg = Registry()
+    h = reg.histogram("crdt_h", "help", (), buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.0, 3.0, 100.0):
+        h.observe(v)
+    assert h.count() == 4
+    assert h.sum() == 104.5
+    out = reg.render()
+    # le="1" holds 0.5 AND the exactly-1.0 observation (Prometheus le is
+    # inclusive); +Inf is the total count
+    assert 'crdt_h_bucket{le="1"} 2' in out
+    assert 'crdt_h_bucket{le="2"} 2' in out
+    assert 'crdt_h_bucket{le="4"} 3' in out
+    assert 'crdt_h_bucket{le="+Inf"} 4' in out
+    assert "crdt_h_count 4" in out
+
+
+def test_registry_get_or_create_idempotent_and_conflicts():
+    reg = Registry()
+    a = reg.counter("crdt_x_total", "help", ("name",))
+    assert reg.counter("crdt_x_total", "help", ("name",)) is a
+    with pytest.raises(ValueError):
+        reg.gauge("crdt_x_total", "help", ("name",))  # kind conflict
+    with pytest.raises(ValueError):
+        reg.counter("crdt_x_total", "help", ("other",))  # label conflict
+    with pytest.raises(ValueError):
+        reg.counter("bad name", "help")  # invalid metric name
+
+
+def test_label_arity_enforced():
+    reg = Registry()
+    c = reg.counter("crdt_y_total", "help", ("a", "b"))
+    with pytest.raises(ValueError):
+        c.inc(1, ("only-one",))
+
+
+def test_render_escapes_label_values():
+    reg = Registry()
+    c = reg.counter("crdt_esc_total", "help", ("name",))
+    c.inc(1, ('we"ird\\v\nal',))
+    line = [l for l in reg.render().splitlines() if l.startswith("crdt_esc")][0]
+    assert '\\"' in line and "\\\\" in line and "\\n" in line
+
+
+def test_collector_runs_at_render_and_errors_are_contained():
+    reg = Registry()
+    g = reg.gauge("crdt_polled", "help")
+    calls = []
+
+    def ok_collector():
+        calls.append(1)
+        g.set(42)
+
+    def bad_collector():
+        raise RuntimeError("dead source")
+
+    reg.register_collector(ok_collector)
+    reg.register_collector(bad_collector)
+    out = reg.render()
+    assert "crdt_polled 42" in out and calls
+    reg.unregister_collector(ok_collector)
+    reg.render()
+    assert len(calls) == 1
+
+
+def test_snapshot_shape():
+    reg = Registry()
+    reg.counter("crdt_s_total", "h", ("name",)).inc(2, ("a",))
+    snap = reg.snapshot()
+    assert snap["crdt_s_total"] == {"type": "counter", "values": {"a": 2.0}}
+
+
+# ----------------------------------------------------------------------
+# the telemetry -> metrics bridge
+
+
+def test_bridge_table_covers_every_declared_event():
+    """The runtime mirror of crdtlint OBS001: every declared event
+    tuple has a subscription row."""
+    reg = Registry()
+    bridge = MetricsBridge(reg)
+    subscribed = {ev for ev, _h in bridge._table()}
+    assert subscribed == set(telemetry.declared_events())
+
+
+def test_bridge_folds_events_into_metrics():
+    reg = Registry()
+    bridge = MetricsBridge(reg).attach()
+    try:
+        telemetry.execute(
+            telemetry.SYNC_DONE, {"keys_updated_count": 3}, {"name": "r1"}
+        )
+        telemetry.execute(
+            telemetry.SYNC_ROUND,
+            {"duration_s": 0.01, "buckets": 4, "entries": 9},
+            {"name": "r1", "plane": "host"},
+        )
+        telemetry.execute(
+            telemetry.FLEET_DISPATCH,
+            {"replicas": 3, "messages": 7, "rows": 10, "padded_rows": 12,
+             "duration_s": 0.002},
+            {"fleet": 123},
+        )
+        assert bridge.sync_done.value(("r1",)) == 1
+        assert bridge.keys_updated.value(("r1",)) == 3
+        assert bridge.sync_entries.value(("r1", "host")) == 9
+        assert bridge.sync_seconds.count(("r1", "host")) == 1
+        assert bridge.fleet_messages.value(("123",)) == 7
+    finally:
+        bridge.detach()
+    # detached: further events no longer fold
+    telemetry.execute(
+        telemetry.SYNC_DONE, {"keys_updated_count": 1}, {"name": "r1"}
+    )
+    assert bridge.sync_done.value(("r1",)) == 1
+
+
+def test_bridge_batch_handlers_match_per_message_folds():
+    """execute_many through the bridge's batch handlers produces the
+    EXACT registry values a loop of per-message execute calls does —
+    the amortisation must never change a metric."""
+    meas_done = [{"keys_updated_count": n} for n in (3, 0, 7, 2)]
+    meas_round = [
+        {"duration_s": 0.001 * (i + 1), "buckets": i, "entries": 2 * i}
+        for i in range(4)
+    ]
+
+    reg_a, reg_b = Registry(), Registry()
+    for reg, batched in ((reg_a, True), (reg_b, False)):
+        bridge = MetricsBridge(reg).attach()
+        try:
+            if batched:
+                telemetry.execute_many(
+                    telemetry.SYNC_DONE, meas_done, {"name": "r1"}
+                )
+                telemetry.execute_many(
+                    telemetry.SYNC_ROUND, meas_round,
+                    {"name": "r1", "plane": "host"},
+                )
+            else:
+                for m in meas_done:
+                    telemetry.execute(telemetry.SYNC_DONE, m, {"name": "r1"})
+                for m in meas_round:
+                    telemetry.execute(
+                        telemetry.SYNC_ROUND, m, {"name": "r1", "plane": "host"}
+                    )
+        finally:
+            bridge.detach()
+    assert reg_a.snapshot() == reg_b.snapshot()
+    assert reg_a.get("crdt_sync_done_total").value(("r1",)) == 4
+    assert reg_a.get("crdt_sync_keys_updated_total").value(("r1",)) == 12
+    assert reg_a.get("crdt_merge_dispatch_seconds").count(("r1", "host")) == 4
+
+
+def test_bridge_attach_is_idempotent():
+    reg = Registry()
+    bridge = MetricsBridge(reg).attach()
+    bridge.attach()  # second attach must not double-subscribe
+    try:
+        telemetry.execute(
+            telemetry.SYNC_DONE, {"keys_updated_count": 1}, {"name": "x"}
+        )
+        assert bridge.sync_done.value(("x",)) == 1
+    finally:
+        bridge.detach()
+    assert not telemetry.has_handlers(telemetry.SYNC_DONE)
+
+
+# ----------------------------------------------------------------------
+# flight recorder
+
+
+def test_flight_recorder_ring_and_drop_accounting():
+    fr = FlightRecorder("r1", capacity=4)
+    for i in range(10):
+        fr.record("sync_open", seq=i)
+    events = fr.events()
+    assert len(events) == 4
+    assert [e["seq"] for e in events] == [6, 7, 8, 9]  # oldest dropped
+    assert fr.dropped() == 6
+    assert fr.events_recorded() == 10
+    assert events[0]["kind"] == "sync_open"
+    assert fr.events(kind="nope") == []
+
+
+def test_flight_recorder_dump_goes_through_logger():
+    import logging
+
+    fr = FlightRecorder("r2", capacity=8)
+    fr.record("growth", capacity=128)
+    records = []
+
+    class Sink(logging.Handler):
+        def emit(self, rec):
+            records.append(rec.getMessage())
+
+    log = logging.getLogger("test-flight-sink")
+    log.addHandler(Sink())
+    assert fr.dump(log) == 1
+    assert any("growth" in m for m in records)
+
+
+def test_flight_recorder_validates_capacity():
+    with pytest.raises(ValueError):
+        FlightRecorder("x", capacity=0)
+
+
+# ----------------------------------------------------------------------
+# lag tracer
+
+
+def test_lag_tracer_matches_every_peer_once():
+    reg = Registry()
+    tr = LagTracer(reg, sample_every=1)
+    tr.note_commit("origin", 1, now=10.0)
+    tr.note_visible("p1", "origin", 1, now=10.5)
+    tr.note_visible("p2", "origin", 1, now=11.0)
+    # repeated advance by the same peer must not double-count
+    tr.note_visible("p1", "origin", 5, now=12.0)
+    assert tr.lag.count(("origin", "p1")) == 1
+    assert tr.lag.count(("origin", "p2")) == 1
+    assert tr.lag.sum(("origin", "p1")) == pytest.approx(0.5)
+    assert tr.lag.sum(("origin", "p2")) == pytest.approx(1.0)
+    assert tr.peers_seen() == {"p1", "p2"}
+
+
+def test_lag_tracer_self_visibility_ignored():
+    tr = LagTracer(Registry(), sample_every=1)
+    tr.note_commit("o", 1, now=0.0)
+    tr.note_visible("o", "o", 1, now=1.0)
+    assert tr.peers_seen() == set()
+
+
+def test_lag_tracer_sampling_rate():
+    tr = LagTracer(Registry(), sample_every=4)
+    for seq in range(1, 9):
+        tr.note_commit("o", seq, now=0.0)
+    tr.note_visible("p", "o", 8, now=1.0)
+    assert tr.lag.count(("o", "p")) == 2  # seqs 4 and 8
+
+
+def test_lag_tracer_propagation_rounds():
+    reg = Registry()
+    tr = LagTracer(reg, sample_every=1)
+    tr.note_commit("o", 1, now=0.0)
+    tr.note_round("o")
+    tr.note_round("o")
+    tr.note_visible("p", "o", 1, now=1.0)
+    assert tr.rounds.count(("o", "p")) == 1
+    assert tr.rounds.sum(("o", "p")) == 2  # waited through 2 rounds
+
+
+def test_lag_tracer_watermark_below_sample_matches_nothing():
+    tr = LagTracer(Registry(), sample_every=1)
+    tr.note_commit("o", 10, now=0.0)
+    tr.note_visible("p", "o", 9, now=1.0)
+    assert tr.lag.count(("o", "p")) == 0
+
+
+def test_lag_tracer_pending_bounds():
+    tr = LagTracer(Registry(), sample_every=1)
+    for seq in range(1, tr.MAX_PENDING + 10):
+        tr.note_commit("o", seq, now=0.0)
+    tr.note_visible("p", "o", tr.MAX_PENDING + 9, now=1.0)
+    assert tr.lag.count(("o", "p")) == tr.MAX_PENDING  # oldest evicted
+
+
+def test_lag_tracer_backward_seq_resets_origin():
+    """A backward seq means the origin restarted (recovery resumes
+    from a snapshot): the dead incarnation's samples and floors are
+    dropped so the new incarnation's lag is measured fresh."""
+    tr = LagTracer(Registry(), sample_every=1)
+    tr.note_commit("o", 10, now=0.0)
+    tr.note_commit("o", 20, now=0.0)
+    tr.note_visible("p", "o", 20, now=1.0)
+    assert tr.lag.count(("o", "p")) == 2
+    tr.note_commit("o", 5, now=2.0)  # restart: seq went backwards
+    tr.note_visible("p", "o", 5, now=3.0)
+    assert tr.lag.count(("o", "p")) == 3  # old floor (20) dropped too
+    assert tr.lag.sum(("o", "p")) == 1.0 + 1.0 + 1.0
+
+
+def test_lag_tracer_validates_sample_every():
+    with pytest.raises(ValueError):
+        LagTracer(Registry(), sample_every=0)
+
+
+# ----------------------------------------------------------------------
+# the Observability facade + the obs= knob
+
+
+def test_resolve_obs_semantics():
+    import delta_crdt_ex_tpu.runtime.metrics as metrics_mod
+
+    assert resolve_obs(None) is None
+    assert resolve_obs(False) is None
+    plane = Observability()
+    try:
+        assert resolve_obs(plane) is plane
+    finally:
+        plane.close()
+    default = resolve_obs(True)
+    try:
+        assert default is default_observability()
+    finally:
+        # in production the process default stays attached for the
+        # process lifetime; in THIS process it must not leak its
+        # always-attached bridge into every later test
+        default.close()
+        metrics_mod._default_obs = None
+    with pytest.raises(TypeError):
+        resolve_obs("yes")
+
+
+def test_observability_varz_and_health_aggregation():
+    plane = Observability()
+    try:
+        plane.add_varz_source("a", lambda: {"kind": "x", "stats": {"n": 1}})
+        plane.add_varz_source("dying", lambda: 1 / 0)
+        plane.add_health_check("ok", lambda: {"ok": True})
+        varz = plane.varz()
+        assert varz["sources"]["a"]["stats"]["n"] == 1
+        assert "error" in varz["sources"]["dying"]
+        ok, detail = plane.health()
+        assert ok and detail["ok"]["ok"]
+        plane.add_health_check("bad", lambda: {"ok": False, "why": "down"})
+        ok, detail = plane.health()
+        assert not ok and not detail["bad"]["ok"]
+        plane.add_health_check("crash", lambda: 1 / 0)
+        ok, detail = plane.health()
+        assert not ok and "error" in detail["crash"]
+    finally:
+        plane.close()
+
+
+def test_observability_registers_replica_sources(transport):
+    from delta_crdt_ex_tpu.api import start_link
+
+    plane = Observability()
+    try:
+        rep = start_link(
+            threaded=False, transport=transport, obs=plane, name="obs-reg"
+        )
+        rep.mutate("add", ["k", "v"])
+        out = plane.registry.render()
+        assert 'crdt_sync_done_total{name="obs-reg"} 1' in out
+        assert 'crdt_sequence_number{name="obs-reg"} 1' in out
+        assert 'crdt_payloads{name="obs-reg"} 1' in out
+        varz = plane.varz()
+        assert varz["sources"]["replica:obs-reg"]["kind"] == "replica"
+        # stats() schema is UNCHANGED under the envelope (MIGRATING.md)
+        assert varz["sources"]["replica:obs-reg"]["stats"]["payloads"] == 1
+        ok, detail = plane.health()
+        assert ok and detail["replica:obs-reg"]["ok"]
+        rep.stop()
+        # a stopped replica's GAUGES and sources are gone from scrapes
+        # (counters stay — cumulative series are never retracted)
+        out = plane.registry.render()
+        assert 'crdt_sequence_number{name="obs-reg"}' not in out
+        assert 'crdt_payloads{name="obs-reg"}' not in out
+        assert "replica:obs-reg" not in plane.varz()["sources"]
+    finally:
+        plane.close()
+
+
+def test_observability_fleet_registration(transport):
+    from delta_crdt_ex_tpu.api import start_fleet
+
+    plane = Observability()
+    fleet = start_fleet(
+        3, threaded=False, transport=transport, obs=plane,
+        names=[f"fm{i}" for i in range(3)],
+    )
+    try:
+        fleet.replicas[0].mutate("add", ["k", 1])
+        fleet.drain()
+        out = plane.registry.render()
+        assert "crdt_fleet_ticks" in out
+        varz = plane.varz()
+        fleet_sources = [
+            k for k, v in varz["sources"].items() if v.get("kind") == "fleet"
+        ]
+        assert len(fleet_sources) == 1
+        assert all(f"replica:fm{i}" in varz["sources"] for i in range(3))
+        ok, _detail = plane.health()
+        assert ok
+    finally:
+        fleet.stop()
+        # a stopped fleet's gauges are gone from scrapes (same contract
+        # as a stopped replica — no stale last values forever)
+        assert "crdt_fleet_ticks{" not in plane.registry.render()
+        plane.close()
+
+
+def test_replica_flight_recorder_records_sync_opens(transport):
+    from delta_crdt_ex_tpu.api import set_neighbours, start_link
+
+    plane = Observability()
+    try:
+        a = start_link(threaded=False, transport=transport, obs=plane, name="fa")
+        b = start_link(threaded=False, transport=transport, obs=plane, name="fb")
+        set_neighbours(a, [b])
+        a.mutate("add", ["k", "v"])
+        a.sync_to_all()
+        transport.pump()
+        kinds = {e["kind"] for e in a.flight.events()}
+        assert "sync_open" in kinds
+        a.stop()
+        b.stop()
+    finally:
+        plane.close()
+
+
+def test_disabled_obs_pays_nothing(transport):
+    """Without a plane there is no recorder, no tracer, no handlers —
+    the has_handlers guards keep disabled telemetry at a lock check."""
+    from delta_crdt_ex_tpu.api import start_link
+
+    rep = start_link(threaded=False, transport=transport, name="noobs")
+    assert rep.flight is None and rep._lag is None and rep._obs is None
+    for ev in telemetry.declared_events():
+        assert not telemetry.has_handlers(ev)
+    rep.stop()

@@ -1,0 +1,177 @@
+"""Obs HTTP endpoint smoke (ISSUE 9 CI satellite): start the server on
+an ephemeral port against a live replica, scrape ``/metrics`` +
+``/healthz`` + ``/varz``, and validate the Prometheus text exposition
+line grammar — the tier-1 proof that the export surface actually
+serves."""
+
+from __future__ import annotations
+
+import json
+import re
+import urllib.error
+import urllib.request
+
+import pytest
+
+from delta_crdt_ex_tpu.api import set_neighbours, start_link
+from delta_crdt_ex_tpu.runtime.metrics import Observability
+
+#: exposition format 0.0.4 line grammar: HELP/TYPE comments or a sample
+#: ``name{labels} value`` line (labels optional, value int/float/±Inf)
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\.)*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\.)*\")*\})?"
+    r" [-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|Inf|NaN)$"
+)
+_COMMENT_RE = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*( .*)?$")
+
+
+@pytest.fixture
+def plane():
+    p = Observability(lag_sample_every=1)
+    yield p
+    p.close()
+
+
+@pytest.fixture
+def served(plane, transport):
+    a = start_link(threaded=False, transport=transport, obs=plane, name="srv-a")
+    b = start_link(threaded=False, transport=transport, obs=plane, name="srv-b")
+    set_neighbours(a, [b])
+    set_neighbours(b, [a])
+    a.mutate("add", ["k1", "v1"])
+    b.mutate("add", ["k2", "v2"])
+    for _ in range(4):
+        a.sync_to_all()
+        b.sync_to_all()
+        transport.pump()
+    server = plane.serve(port=0)  # ephemeral port: parallel test safety
+    yield plane, server, a, b
+    a.stop()
+    b.stop()
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read().decode()
+
+
+def test_metrics_exposition_grammar(served):
+    plane, server, _a, _b = served
+    status, ctype, body = _get(server.url + "/metrics")
+    assert status == 200
+    assert ctype.startswith("text/plain")
+    lines = [l for l in body.splitlines() if l]
+    assert lines, "empty exposition"
+    for line in lines:
+        assert _COMMENT_RE.match(line) or _SAMPLE_RE.match(line), (
+            f"exposition grammar violation: {line!r}"
+        )
+    # every TYPE'd family renders samples of that family, HELP precedes
+    assert "# TYPE crdt_sync_done_total counter" in body
+    assert 'crdt_sync_done_total{name="srv-a"}' in body
+    # scrape-time collector gauges are present (mailbox/seq polled live)
+    assert 'crdt_sequence_number{name="srv-a"}' in body
+    # histograms export the full _bucket/_sum/_count triple
+    assert 'crdt_merge_dispatch_seconds_bucket{le="+Inf",name="srv-a",plane="host"}' in body
+    assert "crdt_merge_dispatch_seconds_sum" in body
+    assert "crdt_merge_dispatch_seconds_count" in body
+    # the lag tracer's per-peer histograms are on the same page
+    assert "crdt_replication_lag_seconds_bucket" in body
+
+
+def test_healthz_contract(served):
+    plane, server, _a, _b = served
+    status, ctype, body = _get(server.url + "/healthz")
+    assert status == 200 and ctype.startswith("application/json")
+    doc = json.loads(body)
+    assert doc["status"] == "ok"
+    assert doc["checks"]["replica:srv-a"]["ok"] is True
+    assert doc["checks"]["replica:srv-a"]["wal_writable"] is True
+
+    # one failing check flips the page to 503 (the k8s probe contract)
+    plane.add_health_check("injected", lambda: {"ok": False, "why": "test"})
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(server.url + "/healthz")
+        assert exc.value.code == 503
+        doc = json.loads(exc.value.read().decode())
+        assert doc["status"] == "unhealthy"
+        assert doc["checks"]["injected"]["ok"] is False
+    finally:
+        plane.remove_source("injected")
+
+
+def test_varz_unifies_stats_sources(served):
+    plane, server, a, _b = served
+    status, _ctype, body = _get(server.url + "/varz")
+    assert status == 200
+    doc = json.loads(body)
+    stanza = doc["sources"]["replica:srv-a"]
+    assert stanza["kind"] == "replica"
+    # the stats() dict rides UNCHANGED under the envelope — including
+    # the wal/ingress/catchup sub-dicts tests already rely on
+    live = a.stats()
+    assert stanza["stats"]["sequence_number"] == live["sequence_number"]
+    assert set(stanza["stats"]) == set(live)
+    assert doc["metrics_families"] > 0
+
+
+def test_root_and_unknown_paths(served):
+    _plane, server, _a, _b = served
+    status, _ctype, body = _get(server.url + "/")
+    assert status == 200 and "/metrics" in body
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(server.url + "/nope")
+    assert exc.value.code == 404
+
+
+def test_serve_is_idempotent_and_stop_releases(plane):
+    s1 = plane.serve(port=0)
+    s2 = plane.serve(port=0)
+    assert s1 is s2
+    url = s1.url
+    _get(url + "/metrics")
+    plane.close()
+    with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+        _get(url + "/metrics")
+
+
+def test_wal_and_transport_gauges_scrape(tmp_path, transport):
+    plane = Observability()
+    try:
+        rep = start_link(
+            threaded=False, transport=transport, obs=plane,
+            name="walrep", wal_dir=str(tmp_path), fsync_mode="none",
+        )
+        rep.mutate("add", ["k", "v"])
+        out = plane.registry.render()
+        assert 'crdt_wal_segments{name="walrep"} 1' in out
+        assert 'crdt_wal_append_records_total{name="walrep"} 1' in out
+        m = re.search(r'crdt_wal_bytes\{name="walrep"\} (\d+)', out)
+        assert m and int(m.group(1)) > 0
+        assert int(m.group(1)) == rep.wal_size_bytes()
+        rep.stop()
+    finally:
+        plane.close()
+
+
+def test_flight_recorder_dumped_on_crash(tmp_path, transport, caplog):
+    import logging
+
+    plane = Observability()
+    try:
+        rep = start_link(
+            threaded=False, transport=transport, obs=plane, name="crashy",
+            wal_dir=str(tmp_path), fsync_mode="none",
+        )
+        rep.mutate("add", ["k", "v"])
+        rep.checkpoint()  # records a wal_compact flight event
+        assert rep.flight.events(kind="wal_compact")
+        with caplog.at_level(logging.ERROR, logger="delta_crdt_ex_tpu"):
+            rep.crash()
+        assert any("flight recorder" in m for m in caplog.messages)
+        assert any("wal_compact" in m for m in caplog.messages)
+    finally:
+        plane.close()

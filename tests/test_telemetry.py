@@ -1,0 +1,222 @@
+"""telemetry attach/detach racing execute (ISSUE 9 satellite).
+
+The handler table is a module global shared by every replica loop and
+fleet tick thread in the process (the RACE gate pins its lock with a
+real-tree injection in test_crdtlint.py); these tests drive the REAL
+races: handlers attached/detached mid-stream while threaded replicas
+and fleet loops execute events concurrently — no exceptions, no torn
+handler lists, and a detached handler stops receiving."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from delta_crdt_ex_tpu.api import set_neighbours, start_fleet, start_link
+from delta_crdt_ex_tpu.runtime import telemetry
+
+
+@pytest.fixture(autouse=True)
+def _isolated_telemetry_handlers():
+    """Earlier suites attach throwaway handlers without detaching; the
+    emptiness assertions here are about THIS module's churn, so start
+    and end with a clean process-global table."""
+    with telemetry._lock:
+        telemetry._handlers.clear()
+    yield
+    with telemetry._lock:
+        telemetry._handlers.clear()
+
+
+def test_attach_detach_race_execute_threaded():
+    """Raw module-level race: executors hammer every declared event
+    while the main thread attaches/detaches handlers. The lock-copied
+    handler snapshot means a handler sees a consistent call or none —
+    never a torn list."""
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def executor():
+        try:
+            while not stop.is_set():
+                for ev in telemetry.declared_events():
+                    telemetry.execute(ev, {"n": 1}, {"name": "race"})
+        except BaseException as e:  # noqa: BLE001 - the assertion surface
+            errors.append(e)
+
+    threads = [threading.Thread(target=executor, daemon=True) for _ in range(3)]
+    for t in threads:
+        t.start()
+    calls = [0]
+
+    def handler(_ev, _meas, _meta):
+        calls[0] += 1
+
+    try:
+        for _ in range(200):
+            for ev in telemetry.declared_events():
+                telemetry.attach(ev, handler)
+            for ev in telemetry.declared_events():
+                telemetry.detach(ev, handler)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert not errors, errors
+    # fully detached: the table is clean and execute reaches no one
+    for ev in telemetry.declared_events():
+        assert not telemetry.has_handlers(ev)
+    before = calls[0]
+    telemetry.execute(telemetry.SYNC_DONE, {"n": 1}, {"name": "race"})
+    assert calls[0] == before
+
+
+def test_attach_detach_race_replica_loop(transport):
+    """Handlers attached/detached while a THREADED replica's event loop
+    emits from merges and mutations — the live replica-loop half of the
+    race."""
+    a = start_link(
+        threaded=True, transport=transport, name="tel-a", sync_interval=0.005
+    )
+    b = start_link(
+        threaded=True, transport=transport, name="tel-b", sync_interval=0.005
+    )
+    set_neighbours(a, [b])
+    set_neighbours(b, [a])
+    seen = []
+
+    def handler(_ev, meas, meta):
+        seen.append((dict(meas), dict(meta)))
+
+    try:
+        deadline = time.monotonic() + 2.0
+        i = 0
+        while time.monotonic() < deadline:
+            telemetry.attach(telemetry.SYNC_DONE, handler)
+            a.mutate("add", [f"k{i}", i])
+            telemetry.detach(telemetry.SYNC_DONE, handler)
+            b.mutate("add", [f"p{i}", i])
+            i += 1
+        assert seen, "attached windows never observed an event"
+        for meas, meta in seen:
+            assert "keys_updated_count" in meas and "name" in meta
+    finally:
+        telemetry.detach(telemetry.SYNC_DONE, handler)
+        a.stop()
+        b.stop()
+    assert not telemetry.has_handlers(telemetry.SYNC_DONE)
+
+
+def test_attach_detach_race_fleet_tick_thread(transport):
+    """Same race against a threaded FLEET's tick thread (the other
+    execute source the thread graph names): members merge under the
+    fleet loop while handlers churn."""
+    fleet = start_fleet(
+        4, threaded=True, transport=transport, sync_interval=0.005,
+        names=[f"telf{i}" for i in range(4)],
+    )
+    reps = fleet.replicas
+    for r in reps:
+        set_neighbours(r, [p for p in reps if p is not r])
+    counts = [0]
+
+    def handler(_ev, _meas, _meta):
+        counts[0] += 1
+
+    events = (telemetry.SYNC_DONE, telemetry.SYNC_ROUND, telemetry.FLEET_DISPATCH)
+    try:
+        deadline = time.monotonic() + 2.0
+        i = 0
+        while time.monotonic() < deadline:
+            for ev in events:
+                telemetry.attach(ev, handler)
+            reps[i % len(reps)].mutate_async("add", [f"k{i}", i])
+            time.sleep(0.002)
+            for ev in events:
+                telemetry.detach(ev, handler)
+            i += 1
+        assert counts[0] > 0, "attached windows never observed an event"
+    finally:
+        for ev in events:
+            telemetry.detach(ev, handler)
+        fleet.stop()
+    for ev in events:
+        assert not telemetry.has_handlers(ev)
+
+
+# ---------------------------------------------------------------------------
+# execute_many — the batch emission form the grouped ingest path uses
+
+
+def test_execute_many_plain_handler_sees_per_message_stream():
+    """A handler WITHOUT a batch attribute observes the exact stream a
+    loop of execute() calls would deliver — order and payloads — so the
+    per-message SYNC_DONE/SYNC_ROUND parity contracts hold verbatim."""
+    seen: list = []
+
+    def handler(ev, meas, meta):
+        seen.append((ev, meas, meta))
+
+    meas_list = [{"keys_updated_count": n} for n in (3, 0, 7)]
+    meta = {"name": "x"}
+    telemetry.attach(telemetry.SYNC_DONE, handler)
+    try:
+        telemetry.execute_many(telemetry.SYNC_DONE, meas_list, meta)
+    finally:
+        telemetry.detach(telemetry.SYNC_DONE, handler)
+    assert seen == [(telemetry.SYNC_DONE, m, meta) for m in meas_list]
+
+
+def test_execute_many_batch_handler_gets_one_call():
+    """A handler carrying a ``batch`` attribute consumes the whole list
+    in ONE call (the metrics bridge's amortisation path)."""
+    per_message: list = []
+    batches: list = []
+
+    def handler(ev, meas, meta):
+        per_message.append(meas)
+
+    handler.batch = lambda ev, meas_list, meta: batches.append(
+        (ev, list(meas_list), meta)
+    )
+
+    meas_list = [{"keys_updated_count": n} for n in range(5)]
+    telemetry.attach(telemetry.SYNC_DONE, handler)
+    try:
+        telemetry.execute_many(telemetry.SYNC_DONE, meas_list, {"name": "x"})
+        # plain execute still takes the per-message path
+        telemetry.execute(telemetry.SYNC_DONE, {"keys_updated_count": 9}, {})
+    finally:
+        telemetry.detach(telemetry.SYNC_DONE, handler)
+    assert batches == [(telemetry.SYNC_DONE, meas_list, {"name": "x"})]
+    assert per_message == [{"keys_updated_count": 9}]
+
+
+def test_execute_many_mixed_handlers():
+    """Batch and plain handlers coexist on one event: each consumes the
+    same batch through its own form."""
+    plain: list = []
+    batched: list = []
+
+    def plain_h(ev, meas, meta):
+        plain.append(meas["keys_updated_count"])
+
+    def batch_capable(ev, meas, meta):  # pragma: no cover - batch wins
+        raise AssertionError("execute_many must prefer .batch")
+
+    batch_capable.batch = lambda ev, ml, meta: batched.extend(
+        m["keys_updated_count"] for m in ml
+    )
+
+    meas_list = [{"keys_updated_count": n} for n in (1, 2, 3)]
+    telemetry.attach(telemetry.SYNC_DONE, plain_h)
+    telemetry.attach(telemetry.SYNC_DONE, batch_capable)
+    try:
+        telemetry.execute_many(telemetry.SYNC_DONE, meas_list, {})
+    finally:
+        telemetry.detach(telemetry.SYNC_DONE, plain_h)
+        telemetry.detach(telemetry.SYNC_DONE, batch_capable)
+    assert plain == [1, 2, 3]
+    assert batched == [1, 2, 3]

@@ -73,6 +73,12 @@ RULE_CATALOG = [
     ("WAL002", "WAL record kind produced without explicit serving "
                "classification in the log-shipping scan — catch-up silently "
                "degrades to the walk"),
+    ("OBS001", "telemetry event declared without an emission site or without "
+               "a metrics-bridge subscription row — the always-attached "
+               "consumer drops it and its metrics read zero"),
+    ("OBS002", "unguarded telemetry.execute in a hot-path module "
+               "(replica/fleet/transports) — disabled telemetry still pays "
+               "dict building there; guard with telemetry.has_handlers"),
     ("SUPPRESS001", "stale allow[...] comment matching no finding (hygiene; "
                     "not itself suppressible)"),
     ("SUPPRESS002", "stale baseline entry matching no finding (hygiene; "

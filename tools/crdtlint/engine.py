@@ -29,6 +29,7 @@ FAMILY_TAGS = {
     "donation": "DONATE",
     "wire": "WIRE",
     "wal": "WAL",
+    "obs": "OBS",
 }
 
 #: hygiene meta-rules (stale suppressions). They report on the

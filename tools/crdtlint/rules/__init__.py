@@ -82,6 +82,7 @@ from tools.crdtlint.rules.purity import check_purity
 from tools.crdtlint.rules.donation import check_donation
 from tools.crdtlint.rules.wire import check_wire
 from tools.crdtlint.rules.walkinds import check_wal_kinds
+from tools.crdtlint.rules.obs import check_obs
 
 ALL_RULES = [
     check_lock_discipline,
@@ -92,4 +93,5 @@ ALL_RULES = [
     check_donation,
     check_wire,
     check_wal_kinds,
+    check_obs,
 ]

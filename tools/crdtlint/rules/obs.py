@@ -1,0 +1,301 @@
+"""OBS001/OBS002 — observability-plane coverage and hot-path guards.
+
+The metrics plane (ISSUE 9) only works if two structural invariants
+hold, and both silently rot without a gate:
+
+- **OBS001** — every event tuple declared in ``runtime/telemetry.py``
+  must have ≥1 ``telemetry.execute`` emission site somewhere in the
+  package AND a subscription row in the metrics bridge's ``_table``
+  (``runtime/metrics.py``). A declared-but-never-emitted event is a
+  dead contract; a declared-but-unbridged event means the one
+  always-attached consumer drops it on the floor — its metrics read
+  zero forever while the emitting code pays full price. Also fires
+  when events are declared but NO bridge table exists at all (a rename
+  must not disarm the rule).
+- **OBS002** — a ``telemetry.execute`` call in a hot-path module
+  (replica / fleet / transports) not guarded by
+  ``telemetry.has_handlers(...)``: with telemetry disabled the call
+  still builds its measurement/metadata dicts (and often pays a device
+  readback) on every merge. Guards may be inline
+  (``if telemetry.has_handlers(E):``), hoisted through a local
+  (``want = telemetry.has_handlers(E)`` … ``if want:``), or enclose a
+  nested ``def`` — a closure whose *definition* sits under the guard
+  can only ever be called when the guard held, so its body inherits
+  the guarded state (the deferred-emission idiom on the drain path).
+
+Discovery is structural, not name-listed: declared events are the
+UPPERCASE module-level tuple-of-strings assignments in a module whose
+dotted name ends in ``telemetry``; the bridge table is any ``_table``
+function returning a list of ``(event, handler)`` tuples; hot modules
+are those whose last dotted part is ``replica`` / ``fleet`` /
+``transport`` / ``tcp_transport``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.crdtlint.engine import Finding, ModuleInfo, Project
+from tools.crdtlint.rules import call_leaf, iter_function_defs
+
+RULE_COVERAGE = "OBS001"
+RULE_GUARD = "OBS002"
+
+_HOT_LEAVES = {"replica", "fleet", "transport", "tcp_transport"}
+
+
+def _telemetry_module(project: Project) -> ModuleInfo | None:
+    for name in sorted(project.modules):
+        if name.rsplit(".", 1)[-1] == "telemetry":
+            return project.modules[name]
+    return None
+
+
+def _declared_events(mod: ModuleInfo) -> dict[str, int]:
+    """UPPERCASE module-level ``NAME = ("a", "b", ...)`` assignments —
+    the declared event vocabulary, with declaration lines."""
+    out: dict[str, int] = {}
+    for node in mod.tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if not (isinstance(t, ast.Name) and t.id.isupper()):
+            continue
+        v = node.value
+        if (
+            isinstance(v, ast.Tuple)
+            and v.elts
+            and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in v.elts
+            )
+        ):
+            out[t.id] = node.lineno
+    return out
+
+
+def _event_name(node: ast.AST, declared: dict[str, int]) -> str | None:
+    """``telemetry.SYNC_DONE`` / bare ``SYNC_DONE`` -> "SYNC_DONE"."""
+    if isinstance(node, ast.Attribute) and node.attr in declared:
+        return node.attr
+    if isinstance(node, ast.Name) and node.id in declared:
+        return node.id
+    return None
+
+
+def _is_telemetry_call(
+    node: ast.Call, mod: ModuleInfo, project: Project, leaf: str
+) -> bool:
+    """Is this a ``telemetry.<leaf>(...)`` / imported ``<leaf>(...)``
+    call on the project's telemetry module? Resolution goes through the
+    import table, with a literal ``telemetry.`` receiver accepted as a
+    fallback (the universal idiom in this tree)."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == leaf:
+        if isinstance(f.value, ast.Name):
+            imp = mod.imports.get(f.value.id)
+            if imp is not None and imp[0] in ("mod", "modroot"):
+                if imp[1].rsplit(".", 1)[-1] == "telemetry":
+                    return True
+            return f.value.id == "telemetry"
+        return False
+    if isinstance(f, ast.Name) and f.id == leaf:
+        imp = mod.imports.get(f.id)
+        return (
+            imp is not None
+            and imp[0] == "sym"
+            and imp[1].rsplit(".", 1)[-1] == "telemetry"
+        )
+    return False
+
+
+_EMIT_LEAVES = ("execute", "execute_many")
+
+
+def _is_emit_call(node: ast.Call, mod: ModuleInfo, project: Project) -> bool:
+    leaf = call_leaf(node)
+    return (
+        leaf in _EMIT_LEAVES
+        and bool(node.args)
+        and _is_telemetry_call(node, mod, project, leaf)
+    )
+
+
+def _emitted_events(project: Project, declared: dict[str, int]) -> set[str]:
+    out: set[str] = set()
+    for name in sorted(project.modules):
+        mod = project.modules[name]
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and _is_emit_call(node, mod, project):
+                ev = _event_name(node.args[0], declared)
+                if ev is not None:
+                    out.add(ev)
+    return out
+
+
+def _bridge_tables(
+    project: Project, declared: dict[str, int]
+) -> list[tuple[str, set[str]]]:
+    """Every ``_table`` function returning a list of tuples whose first
+    elements are declared events — ``(qualname, subscribed events)``."""
+    tables: list[tuple[str, set[str]]] = []
+    for name in sorted(project.modules):
+        mod = project.modules[name]
+        for qual, fn in iter_function_defs(mod.tree):
+            if qual[-1] != "_table":
+                continue
+            subscribed: set[str] = set()
+            rows = 0
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Return) or not isinstance(
+                    node.value, (ast.List, ast.Tuple)
+                ):
+                    continue
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Tuple) and elt.elts:
+                        rows += 1
+                        ev = _event_name(elt.elts[0], declared)
+                        if ev is not None:
+                            subscribed.add(ev)
+            if rows:
+                tables.append((f"{mod.rel}:{'.'.join(qual)}", subscribed))
+    return tables
+
+
+def _outer_function_defs(tree: ast.AST):
+    """(qualname_parts, fn) for functions NOT nested inside another
+    function — nested defs are analysed within their parent by
+    ``_unguarded_executes`` so enclosing guards carry into closures."""
+    def walk(node: ast.AST, stack: tuple[str, ...]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield stack + (child.name,), child
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, stack + (child.name,))
+            else:
+                yield from walk(child, stack)
+    yield from walk(tree, ())
+
+
+def _guard_locals(fn: ast.FunctionDef, mod, project) -> set[str]:
+    """Names bound from a ``has_handlers(...)`` call in this function —
+    the hoisted-guard idiom (``want = telemetry.has_handlers(E)``)."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and call_leaf(node.value) == "has_handlers"
+            and _is_telemetry_call(node.value, mod, project, "has_handlers")
+        ):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _test_guards(test: ast.AST, mod, project, guard_names: set[str]) -> bool:
+    for n in ast.walk(test):
+        if (
+            isinstance(n, ast.Call)
+            and call_leaf(n) == "has_handlers"
+            and _is_telemetry_call(n, mod, project, "has_handlers")
+        ):
+            return True
+        if isinstance(n, ast.Name) and n.id in guard_names:
+            return True
+    return False
+
+
+def _unguarded_executes(
+    fn: ast.FunctionDef, mod: ModuleInfo, project: Project,
+    declared: dict[str, int],
+) -> list[tuple[int, str, tuple[str, ...]]]:
+    guard_names = _guard_locals(fn, mod, project)
+    out: list[tuple[int, str, tuple[str, ...]]] = []
+
+    def walk(node: ast.AST, guarded: bool, names: tuple[str, ...]) -> None:
+        if isinstance(node, ast.If):
+            g = guarded or _test_guards(node.test, mod, project, guard_names)
+            for c in node.body:
+                walk(c, g, names)
+            for c in node.orelse:
+                walk(c, guarded, names)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a closure defined under the guard can only ever run when
+            # the guard held — its body inherits the def site's state
+            # (guard locals are shared: closures read enclosing names)
+            for c in node.body:
+                walk(c, guarded, names + (node.name,))
+            return
+        if isinstance(node, ast.Call) and _is_emit_call(node, mod, project):
+            ev = _event_name(node.args[0], declared)
+            if ev is not None and not guarded:
+                out.append((node.lineno, ev, names))
+        for c in ast.iter_child_nodes(node):
+            walk(c, guarded, names)
+
+    for stmt in fn.body:
+        walk(stmt, False, ())
+    return out
+
+
+def check_obs(project: Project) -> list[Finding]:
+    tmod = _telemetry_module(project)
+    if tmod is None:
+        return []
+    declared = _declared_events(tmod)
+    if not declared:
+        return []
+    findings: list[Finding] = []
+
+    # -- OBS001: emission + bridge coverage ----------------------------
+    emitted = _emitted_events(project, declared)
+    tables = _bridge_tables(project, declared)
+    if not tables:
+        first = min(declared.values())
+        findings.append(Finding(
+            tmod.rel, first, RULE_COVERAGE,
+            "telemetry events are declared but no metrics-bridge "
+            "subscription table was found (a `_table` function returning "
+            "(event, handler) rows) — the always-attached consumer is "
+            "gone and every metric it fed reads zero",
+        ))
+    subscribed = set().union(*(s for _q, s in tables)) if tables else set()
+    for ev in sorted(declared):
+        line = declared[ev]
+        if ev not in emitted:
+            findings.append(Finding(
+                tmod.rel, line, RULE_COVERAGE,
+                f"telemetry event {ev} is declared but never emitted "
+                f"(no telemetry.execute({ev}, ...) site in the package) "
+                f"— dead contract: delete it or emit it",
+            ))
+        if tables and ev not in subscribed:
+            findings.append(Finding(
+                tmod.rel, line, RULE_COVERAGE,
+                f"telemetry event {ev} has no subscription row in the "
+                f"metrics bridge table ({tables[0][0]}) — the "
+                f"always-attached consumer drops it and its metrics "
+                f"read zero forever",
+            ))
+
+    # -- OBS002: hot-path guard discipline -----------------------------
+    for name in sorted(project.modules):
+        mod = project.modules[name]
+        if name.rsplit(".", 1)[-1] not in _HOT_LEAVES:
+            continue
+        for qual, fn in _outer_function_defs(mod.tree):
+            for line, ev, names in _unguarded_executes(
+                fn, mod, project, declared
+            ):
+                findings.append(Finding(
+                    mod.rel, line, RULE_GUARD,
+                    f"unguarded telemetry.execute({ev}) in hot-path "
+                    f"module function {'.'.join(qual + names)} — disabled "
+                    f"telemetry still builds the measurement dicts "
+                    f"here; wrap it in `if telemetry.has_handlers"
+                    f"({ev}):`",
+                ))
+    return findings
