@@ -1145,6 +1145,251 @@ def bench_fleet():
 
     results = {str(n): run_size(n) for n in sizes}
     gate = str(16 if SMOKE else 256)
+
+    # ---- egress leg (ISSUE 10): batched sync ticks vs N sync_to_all ----
+
+    import pickle
+
+    from delta_crdt_ex_tpu.runtime.clock import LogicalClock as _LClock
+    from delta_crdt_ex_tpu.runtime.fleet import Fleet as _Fleet
+
+    class _Sink:
+        """Mailbox-only receiver: registered on the transport so sends
+        route and monitors succeed, never handles anything — the egress
+        bench measures the SENDING side only."""
+
+        device = None
+
+    def _norm_out(msg):
+        """Address-free canonical body of one outbound sync message —
+        the parity witness AND the wire-byte quantity (the twins differ
+        only in names)."""
+        if isinstance(msg, sync_proto.EntriesMsg):
+            return (
+                "entries", np.asarray(msg.buckets),
+                {c: np.asarray(v) for c, v in msg.arrays.items()},
+                msg.payloads,
+            )
+        if isinstance(msg, sync_proto.DiffMsg):
+            return (
+                "diff", msg.level, np.asarray(msg.idx),
+                [np.asarray(b) for b in msg.blocks], msg.seq,
+                msg.log_horizon,
+            )
+        return (type(msg).__name__,)
+
+    def _norm_eq(a, b) -> bool:
+        if type(a) is not type(b):
+            return False
+        if isinstance(a, np.ndarray):
+            return a.shape == b.shape and bool(np.array_equal(a, b))
+        if isinstance(a, dict):
+            return set(a) == set(b) and all(_norm_eq(a[k], b[k]) for k in a)
+        if isinstance(a, (tuple, list)):
+            return len(a) == len(b) and all(map(_norm_eq, a, b))
+        return a == b
+
+    def run_egress_size(n: int) -> dict:
+        _stage(f"fleet egress size {n}: building {2 * n} replicas")
+        transport = LocalTransport()
+        mk = lambda **kw: start_link(
+            AWLWWMap, threaded=False, transport=transport, clock=_LClock(),
+            capacity=(1 << depth) * 16, tree_depth=depth,
+            # in-flight sync slots are cleared explicitly between rounds;
+            # a wall-clock expiry landing between the fleet tick and the
+            # solo loop (loaded host) would open a walk on one side only
+            # and fail the parity gate spuriously
+            sync_timeout=3600.0, **kw,
+        )
+        members = [mk(name=f"eg_f{n}_{i}", node_id=10_000 + i) for i in range(n)]
+        solos = [mk(name=f"eg_o{n}_{i}", node_id=10_000 + i) for i in range(n)]
+        for i in range(n):
+            transport.register(f"eg_fr{n}_{i}", _Sink())
+            transport.register(f"eg_or{n}_{i}", _Sink())
+            members[i].set_neighbours([f"eg_fr{n}_{i}"])
+            solos[i].set_neighbours([f"eg_or{n}_{i}"])
+        fleet = _Fleet(members)
+
+        dts: dict[str, list[float]] = {"fleet": [], "solo": []}
+        msgs_per_tick = bytes_per_tick = 0
+        for rnd in range(rounds + 1):  # round 0 is jit/compile warmup
+            base = 1_000_003 * rnd
+            for i in range(n):
+                for j in range(keys_per_round):
+                    k = base + i * 1000 + j
+                    members[i].mutate("add", [k, k])
+                    solos[i].mutate("add", [k, k])
+            t0 = time.perf_counter()
+            fleet.sync_tick()
+            if rnd > 0:
+                dts["fleet"].append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            for r in solos:
+                r.sync_to_all()
+            if rnd > 0:
+                dts["solo"].append(time.perf_counter() - t0)
+            # in-run parity gate: every receiver pair's streams must be
+            # canonically identical and byte-for-byte equal on the wire
+            rnd_msgs = rnd_bytes = 0
+            for i in range(n):
+                fm = transport.drain(f"eg_fr{n}_{i}")
+                om = transport.drain(f"eg_or{n}_{i}")
+                assert len(fm) == len(om) > 0, (n, rnd, i)
+                for a, b in zip(fm, om):
+                    na, nb = _norm_out(a), _norm_out(b)
+                    assert _norm_eq(na, nb), (n, rnd, i, na[0])
+                    wa = len(pickle.dumps(na, protocol=4))
+                    assert wa == len(pickle.dumps(nb, protocol=4))
+                    rnd_msgs += 1
+                    rnd_bytes += wa
+                # clear in-flight slots identically: every round opens
+                members[i]._outstanding.clear()
+                members[i]._sync_open_seq.clear()
+                solos[i]._outstanding.clear()
+                solos[i]._sync_open_seq.clear()
+            if rnd > 0:
+                msgs_per_tick = rnd_msgs
+                bytes_per_tick = rnd_bytes
+        # cursor-state parity: the batched path advanced exactly what
+        # the per-member loop did
+        for i in range(n):
+            for va, vb in zip(
+                members[i]._push_cursor.values(), solos[i]._push_cursor.values()
+            ):
+                assert np.array_equal(va, vb), (n, i)
+            assert list(members[i]._rm_cursor.values()) == list(
+                solos[i]._rm_cursor.values()
+            ), (n, i)
+
+        rate = lambda ds: n / statistics.median(ds)
+        f_rate, s_rate = rate(dts["fleet"]), rate(dts["solo"])
+        eg = fleet.stats()["egress"]
+        out = {
+            "replicas": n,
+            "fleet_member_syncs_per_sec": round(f_rate, 2),
+            "solo_member_syncs_per_sec": round(s_rate, 2),
+            "speedup": round(f_rate / s_rate, 3),
+            "aggregate_member_syncs_per_sec": {
+                "fleet": round(rounds * n / sum(dts["fleet"]), 2),
+                "solo": round(rounds * n / sum(dts["solo"]), 2),
+            },
+            "messages_per_tick": msgs_per_tick,
+            "wire_bytes_per_tick": bytes_per_tick,
+            "egress_dispatches": eg["dispatches"],
+            "avg_bucket_occupancy": eg["avg_bucket_occupancy"],
+            "batched_jobs": eg["batched_jobs"],
+            "solo_jobs": eg["solo_jobs"],
+            "trees_batched": eg["trees_batched"],
+            "parity": "bit_for_bit_wire_openers_cursors_checked",
+        }
+        log(
+            f"fleet egress {n}: {f_rate:.1f} vs solo {s_rate:.1f} "
+            f"member-syncs/sec ({out['speedup']}x; "
+            f"{msgs_per_tick} msgs/{bytes_per_tick} B per tick, "
+            f"bucket occupancy {eg['avg_bucket_occupancy']})"
+        )
+        return out
+
+    def run_tcp_frame_demo(n: int) -> dict:
+        """FleetFrameMsg aggregation over a real TCP hop: n members'
+        pushes + openers to a co-located peer process ride one frame
+        per endpoint per tick (LocalTransport has no frames, so the
+        frames-per-tick quantity needs the real codec)."""
+        from delta_crdt_ex_tpu.runtime.tcp_transport import TcpTransport
+
+        ta, tb = TcpTransport(), TcpTransport()
+        try:
+            mk = lambda t, nm, nid: start_link(
+                AWLWWMap, threaded=False, transport=t, clock=_LClock(),
+                capacity=(1 << depth) * 16, tree_depth=depth, name=nm,
+                node_id=nid, sync_timeout=3600.0,
+            )
+            members = [mk(ta, f"tcp_m{i}", 20_000 + i) for i in range(n)]
+            peers = [mk(tb, f"tcp_p{i}", 30_000 + i) for i in range(n)]
+            for i in range(n):
+                members[i].set_neighbours([(f"tcp_p{i}", tb.endpoint)])
+            fleet = _Fleet(members)
+            for i in range(n):
+                members[i].mutate("add", [i, i])
+            fleet.sync_tick()  # primes the pooled connection + HELLO
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                with ta._lock:
+                    conn = ta._conns.get(tb.endpoint)
+                if conn is not None and conn.accepts_f:
+                    break
+                time.sleep(0.02)
+            ticks = 3
+            for rnd in range(1, ticks + 1):
+                for i in range(n):
+                    members[i].mutate("add", [rnd * 1000 + i, i])
+                for m in members:
+                    m._outstanding.clear()
+                    m._sync_open_seq.clear()
+                fleet.sync_tick()
+            # convergence through the frames proves the decode path
+            deadline = time.monotonic() + 30.0
+            done = False
+            while time.monotonic() < deadline and not done:
+                for i in range(n):
+                    for msg in tb.drain(f"tcp_p{i}"):
+                        peers[i].handle(msg)
+                done = all(
+                    peers[i].read().get(rnd * 1000 + i) == i
+                    for i in range(n)
+                    for rnd in range(1, ticks + 1)
+                )
+                if not done:
+                    time.sleep(0.02)
+            assert done, "peers did not converge through fleet frames"
+            eg = fleet.stats()["egress"]
+            assert eg["frames"] >= ticks, eg
+            out = {
+                "replicas": n,
+                "ticks": ticks,
+                "frames": eg["frames"],
+                "frame_members": eg["frame_members"],
+                "members_per_frame": eg["members_per_frame"],
+                "frames_per_tick": round(eg["frames"] / eg["ticks"], 3),
+            }
+            log(
+                f"tcp frame demo {n}: {eg['frames']} frames, "
+                f"{eg['members_per_frame']} members/frame"
+            )
+            return out
+        finally:
+            ta.close()
+            tb.close()
+
+    egress_results = {str(n): run_egress_size(n) for n in sizes}
+    tcp_demo = run_tcp_frame_demo(sizes[0])
+
+    import datetime as _dt
+
+    egress_artifact = {
+        "metric": "fleet_egress_member_syncs_per_sec" + ("_smoke" if SMOKE else ""),
+        "unit": "member-syncs/sec",
+        "stat": f"median_of_{rounds}_rounds",
+        "value": egress_results[gate]["fleet_member_syncs_per_sec"],
+        "speedup_at_gate": egress_results[gate]["speedup"],
+        "sizes": egress_results,
+        "tcp_frame_demo": tcp_demo,
+        "rounds": rounds,
+        "keys_per_round": keys_per_round,
+        "tree_depth": depth,
+        "parity": "bit_for_bit_wire_openers_cursors_checked",
+        "backend": "cpu",
+        "utc": _dt.datetime.now(_dt.timezone.utc).isoformat(),
+    }
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks", "results",
+        f"fleet_egress_cpu_{_dt.date.today().strftime('%Y%m%d')}.json",
+    )
+    with open(out_path, "w") as f:
+        json.dump(egress_artifact, f, indent=2)
+        f.write("\n")
+    log(f"fleet egress artifact written to {out_path}")
+
     _emit({
         "metric": "fleet_batched_merges_per_sec" + ("_smoke" if SMOKE else ""),
         "unit": "merges/sec",
@@ -1152,6 +1397,7 @@ def bench_fleet():
         "value": results[gate]["fleet_merges_per_sec"],
         "speedup_at_gate": results[gate]["speedup"],
         "sizes": results,
+        "egress": egress_artifact,
         "rounds": rounds,
         "keys_per_round": keys_per_round,
         "tree_depth": depth,

@@ -190,7 +190,13 @@ def start_fleet(
     dispatch over a leading replica axis, instead of one dispatch (and
     one thread) per replica — the served-users-per-host lever
     (``bench.py --fleet``: ≥3× aggregate merges/sec vs per-replica
-    loops at 256 replicas, bit-for-bit parity asserted in-run).
+    loops at 256 replicas, bit-for-bit parity asserted in-run). Sync
+    ticks batch the egress half the same way (ISSUE 10): one vmapped
+    digest-tree build + one vmapped eager-delta extraction per shape
+    bucket serves every due member, and pushes/openers bound for a
+    co-located peer process ship as one ``FleetFrameMsg`` TCP frame
+    per endpoint per tick (negotiated; legacy peers get per-member
+    frames).
     Observable semantics per member are identical to solo replicas:
     WAL records, acks, diffs, and telemetry fan back out per replica
     (``tests/test_fleet.py`` pins state bits, WAL bytes, and ack

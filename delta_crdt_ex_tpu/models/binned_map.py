@@ -560,6 +560,35 @@ class BinnedAWLWWMap:
 
         return transition.jit_fleet_merge_rows(states, slices)
 
+    # -- batched fleet egress (ISSUE 10): one vmapped extraction serves
+    # a whole sync-tick bucket. Each returns ``(stacked_slice, s_tiers)``
+    # where ``s_tiers`` trims each lane's entry-lane axis back to the
+    # member's own solo tier (None = the backend's lane axis is static
+    # state geometry — nothing to trim; lane k of the stack IS the solo
+    # extraction bit-for-bit).
+
+    @classmethod
+    def fleet_extract_rows(cls, states, rows):
+        from delta_crdt_ex_tpu.runtime import transition
+
+        return transition.jit_fleet_extract_rows(states, rows), None
+
+    @classmethod
+    def fleet_extract_own_delta(cls, states, rows, self_slots, gid_selfs, lo):
+        from delta_crdt_ex_tpu.runtime import transition
+
+        return (
+            transition.jit_fleet_interval_slices(
+                states, rows, self_slots, gid_selfs, lo
+            ),
+            None,
+        )
+
+    # NB: no fleet_tree_from_leaves seam — leaf digests are bit-identical
+    # across backends, so the fleet's batched tree build groups members
+    # by leaf length alone (possibly mixing backends in one stack) and
+    # calls transition.jit_fleet_tree_from_leaves directly.
+
 
 class AWSet(BinnedAWLWWMap):
     """Add-wins observed-remove set — the second δ-CRDT of the reference

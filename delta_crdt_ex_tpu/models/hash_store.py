@@ -468,6 +468,38 @@ class HashAWLWWMap:
 
         return transition.jit_fleet_hash_merge_rows(states, slices)
 
+    # -- batched fleet egress (ISSUE 10): dense extraction sizes its
+    # lane tier by CONTENT, so the bucket runs at the max of the
+    # members' own pow2 tiers and each lane trims back to its solo tier
+    # (``s_tiers``) — ragged members share one compile, shipped bytes
+    # stay bit-for-bit the solo extraction's.
+
+    @classmethod
+    def fleet_extract_rows(cls, states, rows):
+        from delta_crdt_ex_tpu.runtime import transition
+
+        counts = np.asarray(transition.jit_fleet_hash_row_counts(states, rows))
+        tiers = [_dense_lanes(c) for c in counts]
+        sl = transition.jit_fleet_hash_extract_rows(
+            states, rows, lanes=max(tiers)
+        )
+        return sl, tiers
+
+    @classmethod
+    def fleet_extract_own_delta(cls, states, rows, self_slots, gid_selfs, lo):
+        from delta_crdt_ex_tpu.runtime import transition
+
+        counts = np.asarray(
+            transition.jit_fleet_hash_own_delta_counts(states, rows, self_slots, lo)
+        )
+        tiers = [_dense_lanes(c) for c in counts]
+        sl = transition.jit_fleet_hash_interval_slices(
+            states, rows, self_slots, gid_selfs, lo, lanes=max(tiers)
+        )
+        return sl, tiers
+    # (no fleet_tree_from_leaves seam: leaf digests are bit-identical
+    # across backends — the fleet's batched tree build is model-agnostic)
+
 
 class HashAWSet(HashAWLWWMap):
     """Add-wins observed-remove set over the hash store (the

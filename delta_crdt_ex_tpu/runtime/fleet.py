@@ -47,6 +47,17 @@ result of the previous dispatch (``Replica.state`` materialises a
 lane only when something per-replica actually reads it), so the
 steady-state hot path does no per-replica stacking or unstacking —
 the host orchestrates, the device sees one launch per wave bucket.
+
+The EGRESS half of the sync tick is batched too (ISSUE 10,
+:meth:`Fleet.sync_tick`): due members' digest-tree builds, eager-delta
+extractions, and own-counter cursor sources each run as ONE vmapped
+dispatch per shape bucket, fanned back out through the replicas' own
+plan/emit bookkeeping (``Replica._eager_jobs`` / ``_emit_push_job`` /
+``_open_walks``) so wire bytes, opener streams, and cursor state are
+bit-for-bit the per-member loop's. Outbound messages to a co-located
+peer process that negotiated the fleet-frame capability aggregate into
+one :class:`~delta_crdt_ex_tpu.runtime.sync.FleetFrameMsg` TCP frame
+per endpoint per tick (see ``tcp_transport._FLEETF``).
 """
 
 from __future__ import annotations
@@ -56,6 +67,8 @@ import time
 from typing import Any
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 
 from delta_crdt_ex_tpu.models.binned import pow2_tier
 from delta_crdt_ex_tpu.models.binned_map import stack_entry_slices
@@ -65,7 +78,114 @@ from delta_crdt_ex_tpu.runtime import (
     telemetry,
     transition,
 )
-from delta_crdt_ex_tpu.runtime.replica import Replica
+from delta_crdt_ex_tpu.runtime.replica import (
+    Replica,
+    _LaneLevels,
+    _StackedLevels,
+)
+
+
+class _FrameCollector:
+    """Per-transport aggregation of one egress tick's outbound sync
+    messages into fleet-wide wire frames (ISSUE 10): sends whose
+    destination endpoint negotiated the ``_FEAT_FLEET`` capability are
+    buffered as ``(to, msg)`` entries and shipped at :meth:`flush` as
+    ONE :class:`~delta_crdt_ex_tpu.runtime.sync.FleetFrameMsg` per
+    endpoint — many members' eager-delta slices and openers in one TCP
+    frame. Everything else (local peers, legacy peers, transports
+    without ``fleet_sink``) passes straight through to the normal send.
+
+    ``send`` returning True means the message is committed to a frame
+    that a live negotiated connection will carry; a drop after that
+    (sender queue filled mid-tick, peer died) is the same lossy-
+    transport case as a ``_SenderConn`` drop — cursors may run ahead of
+    delivery by one tick and the periodic sync / ``GetDiffMsg`` repair
+    re-covers, exactly the per-member contract."""
+
+    __slots__ = ("transport", "_sink_of", "_sinks", "frames", "senders")
+
+    def __init__(self, transport) -> None:
+        self.transport = transport
+        self._sink_of = getattr(transport, "fleet_sink", None)
+        self._sinks: dict = {}  # per-tick memo: destination -> sink|None
+        self.frames: dict = {}  # endpoint -> [(to, msg), ...] send order
+        self.senders: dict = {}  # endpoint -> distinct member addrs
+
+    def send(self, to, msg) -> bool:
+        if self._sink_of is not None:
+            try:
+                sink = self._sinks[to]
+            except KeyError:
+                # memoised per tick: fleet_sink probes the pooled
+                # connection, and a dead endpoint would otherwise pay a
+                # connect timeout per message instead of per tick
+                sink = self._sinks[to] = self._sink_of(to)
+            except TypeError:
+                sink = self._sink_of(to)  # unhashable addr: no memo
+            if sink is not None:
+                self.frames.setdefault(sink, []).append((to, msg))
+                self.senders.setdefault(sink, set()).add(
+                    getattr(msg, "frm", None)
+                )
+                return True
+        return self.transport.send(to, msg)
+
+    def flush(self) -> "tuple[int, int]":
+        """Ship the buffered envelopes; returns ``(frames, member
+        slots)`` — member slots counts distinct contributing members
+        per shipped frame (the members-per-frame numerator)."""
+        frames = members = 0
+        for sink, entries in self.frames.items():
+            if self.transport.send_fleet_frame(sink, entries):
+                frames += 1
+                members += len(self.senders[sink])
+        self.frames.clear()
+        self.senders.clear()
+        return frames, members
+
+
+#: RowSlice entry columns carried at the dense lane tier (trimmed back
+#: per member on content-sized backends; the ctx tables ride the state's
+#: writer geometry instead and are never lane-tiered)
+_ENTRY_LANE_COLS = ("key", "valh", "ts", "node", "ctr", "alive")
+
+
+def _lane_slice(host, lane: int, rows: np.ndarray, tier: "int | None"):
+    """Lane ``lane`` of a host-fetched stacked RowSlice as the member's
+    solo-form slice: identical to what the member's own extraction
+    would have produced — dense backends pack each row's entries as an
+    arrival-ordered prefix with zeroed dead lanes, so trimming the
+    entry-lane axis to the member's own pow2 tier is exact (bit-for-bit
+    wire parity with the per-member loop)."""
+    out = {}
+    for c in host._fields:
+        a = np.asarray(getattr(host, c))[lane]
+        if tier is not None and c in _ENTRY_LANE_COLS:
+            a = a[:, :tier]
+        out[c] = a
+    out["rows"] = rows  # the job's own planning array (identical values)
+    return type(host)(**out)
+
+
+class _EgressMember:
+    """One member's snapshot through a batched sync tick: the (state,
+    version) pair every batched dispatch reads, the planned push jobs,
+    and the solo-fallback flag for members whose version moved."""
+
+    __slots__ = (
+        "rep", "state", "version", "need_ctr", "need_tree", "own_ctr",
+        "jobs", "solo",
+    )
+
+    def __init__(self, rep, state, version, need_ctr, need_tree):
+        self.rep = rep
+        self.state = state
+        self.version = version
+        self.need_ctr = need_ctr
+        self.need_tree = need_tree
+        self.own_ctr = None
+        self.jobs = None
+        self.solo = False
 
 
 class _Staged:
@@ -135,6 +255,21 @@ class Fleet:
         self._real_rows = 0
         self._padded_rows = 0
         self._fallbacks = {"singleton": 0, "shape": 0, "escape": 0, "stale": 0}
+        # batched egress observability (ISSUE 10): sync-tick passes,
+        # members served, vmapped extraction/tree dispatches, per-bucket
+        # occupancy, jobs that fell back to solo extraction, and the
+        # FleetFrameMsg wire aggregation counters
+        self._egress_ticks = 0
+        self._egress_members = 0
+        self._egress_time = 0.0
+        self._egress_dispatches = 0
+        self._egress_batched_jobs = 0
+        self._egress_solo_jobs = 0
+        self._egress_solo_members = 0
+        self._egress_occupancy: dict[int, int] = {}
+        self._egress_tree_batched = 0
+        self._egress_frames = 0
+        self._egress_frame_members = 0
         #: tick-freshness heartbeat for /healthz (a wedged fleet loop —
         #: stuck dispatch, dead thread — goes stale and flips unready)
         self._tick_ts = time.monotonic()
@@ -396,6 +531,271 @@ class Fleet:
             )
 
     # ------------------------------------------------------------------
+    # batched sync-tick egress (ISSUE 10): one vmapped tree build + one
+    # vmapped delta extraction per shape bucket, fanned back out through
+    # the replicas' own plan/emit bookkeeping
+
+    def sync_tick(self, members: "list | None" = None) -> int:
+        """One sync tick for ``members`` (default: every member) with
+        the egress half batched across the fleet: per-member planning
+        (``Replica._eager_jobs``) and emission (``_emit_push_job`` /
+        ``_open_walks``) run under each member's own lock exactly as
+        ``sync_to_all`` would, but the device work between them — the
+        own-counter cursor source, the eager-delta/full-row slice
+        extractions, and the digest-tree builds — runs as ONE vmapped
+        dispatch per shape bucket over a leading replica axis. Lane k
+        of every batched result is bit-for-bit the solo dispatch on
+        lane k's inputs, so wire bytes, opener streams and cursor state
+        cannot drift from the per-member loop (``tests/
+        test_fleet_egress.py``, ``bench.py --fleet`` assert it).
+
+        Outbound messages whose destination endpoint negotiated the
+        fleet-frame capability aggregate into one
+        :class:`~delta_crdt_ex_tpu.runtime.sync.FleetFrameMsg` per
+        endpoint per tick (flat gossip today; the frame hierarchical
+        anti-entropy will ride). Returns the number of members synced."""
+        reps = list(self.replicas if members is None else members)
+        if not reps:
+            return 0
+        t0 = time.perf_counter()
+        if len(reps) < self.min_batch:
+            # nothing to amortise: the per-member path is strictly cheaper
+            for rep in reps:
+                rep.sync_to_all()
+            with self._lock:
+                self._egress_ticks += 1
+                self._egress_members += len(reps)
+                self._egress_solo_members += len(reps)
+                self._egress_time += time.perf_counter() - t0
+            return len(reps)
+
+        # phase 0 — per member, under its lock: flush pending mutations,
+        # refresh monitors, snapshot (state, version) as THE source every
+        # batched dispatch below reads; a member whose version moves
+        # before planning replays the whole tick solo
+        staged: list = []
+        for rep in reps:
+            with rep._lock:
+                rep._flush()
+                rep._monitor_neighbours()
+                staged.append(_EgressMember(
+                    rep,
+                    rep.state,
+                    rep._state_version,
+                    rep._own_ctr_cache is None,
+                    rep._tree is None,
+                ))
+
+        # phase 0.5 — batched cursor-source refresh: one gather + one
+        # transfer per ctx geometry group instead of N column reads
+        ctr_groups: dict[tuple, list] = {}
+        for ent in staged:
+            if ent.need_ctr:
+                ctr_groups.setdefault(
+                    tuple(ent.state.ctx_max.shape), []
+                ).append(ent)
+        for items in ctr_groups.values():
+            if len(items) < self.min_batch:
+                continue  # _eager_jobs rebuilds those solo
+            # pad to a pow2 lane tier (lane 0 replicated) — group
+            # membership varies tick to tick with cache invalidation,
+            # and an exact-size stack would recompile per distinct size
+            lanes = pow2_tier(len(items), floor=2)
+            tables = [e.state.ctx_max for e in items]
+            tables += [tables[0]] * (lanes - len(items))
+            slots = np.zeros(lanes, np.int32)
+            slots[: len(items)] = [e.rep.self_slot for e in items]
+            cols = np.asarray(
+                transition.jit_fleet_own_ctr_columns(
+                    transition.jit_stack_pytrees(*tables),
+                    jnp.asarray(slots),
+                )
+            )
+            for lane, e in enumerate(items):
+                e.own_ctr = cols[lane]
+
+        # phase 1 — per member, under its lock: plan the tick's push
+        # jobs against the snapshot (version-guarded: a moved member
+        # would plan against newer state than the batch extracts from,
+        # and its cursors could then overrun the shipped claims)
+        for ent in staged:
+            rep = ent.rep
+            with rep._lock:
+                if rep._state_version != ent.version:
+                    ent.solo = True
+                    continue
+                if ent.own_ctr is not None and rep._own_ctr_cache is None:
+                    rep._own_ctr_cache = ent.own_ctr
+                ent.jobs = rep._eager_jobs()
+
+        # phase 2a — bucket push jobs by (backend geometry, job kind,
+        # row tier) and run ONE vmapped extraction + ONE whole-bucket
+        # host transfer per bucket
+        buckets: dict[tuple, list] = {}
+        for ent in staged:
+            if ent.solo or not ent.jobs:
+                continue
+            geo = ent.rep.model.geometry(ent.state)
+            for job in ent.jobs:
+                key = geo + (job.kind, job.rows.shape[0])
+                buckets.setdefault(key, []).append((ent.rep, ent.state, job))
+        extracted: dict[int, Any] = {}
+        n_dispatch = n_batched_jobs = n_solo_jobs = 0
+        occupancy: dict[int, int] = {}
+        for items in buckets.values():
+            if len(items) < self.min_batch:
+                n_solo_jobs += len(items)
+                continue
+            self._extract_bucket(items, extracted)
+            n_dispatch += 1
+            n_batched_jobs += len(items)
+            occupancy[len(items)] = occupancy.get(len(items), 0) + 1
+
+        # phase 2b — batched digest-tree builds (leaf digests share one
+        # geometry across backends); the opener's top levels prefetch in
+        # one transfer per bucket, deep levels stay device-resident for
+        # the receive-side walks to materialise lazily
+        tree_groups: dict[int, list] = {}
+        for ent in staged:
+            if ent.need_tree and not ent.solo:
+                tree_groups.setdefault(
+                    int(ent.state.leaf.shape[-1]), []
+                ).append(ent)
+        lane_trees: dict[int, tuple] = {}
+        n_tree_batched = 0
+        for items in tree_groups.values():
+            if len(items) < self.min_batch:
+                continue  # _ensure_tree rebuilds those solo
+            # pow2 lane tier like the ctr refresh above: a per-size
+            # stack/build compile on the periodic path would stall a
+            # steady-state fleet every time the due set's size moved
+            lanes = pow2_tier(len(items), floor=2)
+            leaves = [e.state.leaf for e in items]
+            leaves += [leaves[0]] * (lanes - len(items))
+            levels = transition.jit_fleet_tree_from_leaves(
+                transition.jit_stack_pytrees(*leaves)
+            )
+            stack = _StackedLevels(levels)
+            stack.prefetch(max(e.rep.levels_per_round for e in items))
+            n_tree_batched += len(items)
+            for lane, e in enumerate(items):
+                lane_trees[id(e.rep)] = (stack, lane, e.version)
+
+        # phase 3 — per member, under its lock: adopt the batched tree
+        # (version-guarded), emit every job through the shared
+        # _emit_push_job tail (cursor advance, send accounting), open
+        # the walk rounds (the _outstanding / _sync_open_seq bookkeeping
+        # — unchanged), with sends aggregating into fleet frames
+        collectors: dict[int, _FrameCollector] = {}
+        for ent in staged:
+            rep = ent.rep
+            coll = collectors.get(id(rep.transport))
+            if coll is None:
+                coll = collectors[id(rep.transport)] = _FrameCollector(
+                    rep.transport
+                )
+            with rep._lock:
+                if ent.solo:
+                    # stale member: the solo path end-to-end (its own
+                    # plan, extraction, emission and walks)
+                    rep._push_deltas(coll.send)
+                    rep._open_walks(coll.send)
+                    continue
+                tv = lane_trees.get(id(rep))
+                if (
+                    tv is not None
+                    and rep._tree is None
+                    and rep._state_version == tv[2]
+                ):
+                    rep._tree = _LaneLevels(tv[0], tv[1])
+                for job in ent.jobs:
+                    sl = extracted.get(id(job))
+                    if sl is None:
+                        sl = rep._extract_push_job(job)
+                    rep._emit_push_job(job, sl, coll.send)
+                rep._open_walks(coll.send)
+
+        # phase 4 — ship the aggregated fleet frames, one per endpoint
+        frames = frame_members = 0
+        for coll in collectors.values():
+            f, m = coll.flush()
+            frames += f
+            frame_members += m
+
+        dt = time.perf_counter() - t0
+        solo_members = sum(1 for ent in staged if ent.solo)
+        with self._lock:
+            self._egress_ticks += 1
+            self._egress_members += len(reps)
+            self._egress_time += dt
+            self._egress_dispatches += n_dispatch
+            self._egress_batched_jobs += n_batched_jobs
+            self._egress_solo_jobs += n_solo_jobs
+            self._egress_solo_members += solo_members
+            for k, v in occupancy.items():
+                self._egress_occupancy[k] = self._egress_occupancy.get(k, 0) + v
+            self._egress_tree_batched += n_tree_batched
+            self._egress_frames += frames
+            self._egress_frame_members += frame_members
+        if telemetry.has_handlers(telemetry.FLEET_EGRESS):
+            telemetry.execute(
+                telemetry.FLEET_EGRESS,
+                {
+                    "members": len(reps),
+                    "jobs_batched": n_batched_jobs,
+                    "jobs_solo": n_solo_jobs + solo_members,
+                    "dispatches": n_dispatch,
+                    "frames": frames,
+                    "frame_members": frame_members,
+                    "duration_s": dt,
+                },
+                {"fleet": id(self)},
+            )
+        return len(reps)
+
+    def _extract_bucket(self, items: list, extracted: dict) -> None:
+        """One vmapped extraction for a bucket of same-shape push jobs:
+        stack the members' snapshot states and job inputs along a
+        leading replica axis (pow2 lane tier; padding lanes replicate
+        member 0 with all ``-1`` rows — they gather nothing), dispatch
+        the backend's batched form, fetch the WHOLE stacked slice with
+        one host transfer, and hand each job its lane — trimmed back to
+        the member's own solo tier on dense backends — as the host-form
+        slice ``_emit_push_job`` fans out."""
+        model = items[0][0].model
+        n = len(items)
+        lanes = pow2_tier(n, floor=2)
+        states = [st for _rep, st, _job in items]
+        states += [states[0]] * (lanes - n)
+        stacked = transition.jit_stack_pytrees(*states)
+        u = items[0][2].rows.shape[0]
+        rows = np.full((lanes, u), -1, np.int32)
+        for k, (_rep, _st, job) in enumerate(items):
+            rows[k] = job.rows
+        if items[0][2].kind == "delta":
+            slots = np.zeros(lanes, np.int32)
+            gids = np.zeros(lanes, np.uint64)
+            lo = np.zeros((lanes, u), np.uint32)
+            for k, (rep, _st, job) in enumerate(items):
+                slots[k] = rep.self_slot
+                gids[k] = rep.node_id
+                lo[k] = job.lo
+            sl, tiers = model.fleet_extract_own_delta(
+                stacked,
+                jnp.asarray(rows),
+                jnp.asarray(slots),
+                jnp.asarray(gids),
+                jnp.asarray(lo),
+            )
+        else:
+            sl, tiers = model.fleet_extract_rows(stacked, jnp.asarray(rows))
+        host = jax.device_get(sl)  # one transfer for the whole bucket
+        for k, (_rep, _st, job) in enumerate(items):
+            extracted[id(job)] = _lane_slice(
+                host, k, job.rows, None if tiers is None else tiers[k]
+            )
+
+    # ------------------------------------------------------------------
     # periodic duties + the one-thread event loop
 
     def run_duties(self, now: float | None = None) -> None:
@@ -403,13 +803,14 @@ class Fleet:
         loop body of ``Replica.start``, hoisted so N members share one
         thread."""
         now = time.monotonic() if now is None else now
+        due: list = []
         for rep in self.replicas:
             with rep._lock:
                 if rep._pending:
                     rep._flush()
             nxt = getattr(rep, "_fleet_next_sync", 0.0)
             if now >= nxt:
-                rep.sync_to_all()
+                due.append(rep)
                 rep._fleet_next_sync = now + rep.sync_interval
             if rep.storage_mode == "interval" and rep.storage_module is not None:
                 nxt = getattr(rep, "_fleet_next_ckpt", None)
@@ -427,6 +828,11 @@ class Fleet:
                 # state, and the None check sits under the lock too
                 if rep._wal is not None:
                     rep._wal.maybe_sync()
+        if due:
+            # the batched egress: due members' tree builds + delta
+            # extractions share one vmapped dispatch per shape bucket
+            # (the solo loop body's sync_to_all, one altitude up)
+            self.sync_tick(due)
 
     def start(self) -> "Fleet":
         """Run the fleet's event loop in ONE background thread serving
@@ -503,7 +909,50 @@ class Fleet:
                     else 0.0
                 ),
                 "fallbacks": dict(self._fallbacks),
+                "egress": self._egress_stats_held(),
             }
+
+    def _egress_stats_held(self) -> dict:
+        """Batched-egress observability (caller holds the fleet lock):
+        sync-tick throughput, vmapped extraction bucket occupancy, and
+        the FleetFrameMsg wire-aggregation ratios the
+        ``crdt_fleet_egress_*`` gauges export."""
+        occ = dict(sorted(self._egress_occupancy.items()))
+        occ_total = sum(occ.values())
+        return {
+            "ticks": self._egress_ticks,
+            "members_synced": self._egress_members,
+            "ticks_per_sec": (
+                round(self._egress_ticks / self._egress_time, 3)
+                if self._egress_time
+                else 0.0
+            ),
+            "dispatches": self._egress_dispatches,
+            "batched_jobs": self._egress_batched_jobs,
+            "solo_jobs": self._egress_solo_jobs,
+            "solo_members": self._egress_solo_members,
+            "bucket_occupancy_hist": occ,
+            "avg_bucket_occupancy": (
+                round(
+                    sum(k * v for k, v in occ.items()) / occ_total, 3
+                )
+                if occ_total
+                else 0.0
+            ),
+            "trees_batched": self._egress_tree_batched,
+            "frames": self._egress_frames,
+            "frame_members": self._egress_frame_members,
+            "members_per_frame": (
+                round(self._egress_frame_members / self._egress_frames, 3)
+                if self._egress_frames
+                else 0.0
+            ),
+            "frames_per_tick": (
+                round(self._egress_frames / self._egress_ticks, 3)
+                if self._egress_ticks
+                else 0.0
+            ),
+        }
 
     def obs_varz(self) -> dict:
         """The fleet's ``/varz`` stanza: the UNCHANGED :meth:`stats`

@@ -542,6 +542,32 @@ class MetricsBridge:
             "crdt_fleet_padded_rows_total",
             "Padded rows launched by fleet dispatches", ("fleet",),
         )
+        self.fleet_egress_ticks = c(
+            "crdt_fleet_egress_ticks_total",
+            "Batched fleet sync-tick egress passes", ("fleet",),
+        )
+        self.fleet_egress_dispatches = c(
+            "crdt_fleet_egress_dispatches_total",
+            "Vmapped egress extraction/tree dispatches", ("fleet",),
+        )
+        self.fleet_egress_members = h(
+            "crdt_fleet_egress_members",
+            "Members served per batched egress tick", ("fleet",),
+            buckets=COUNT_BUCKETS,
+        )
+        self.fleet_egress_frames = c(
+            "crdt_fleet_egress_frames_total",
+            "FleetFrameMsg envelopes shipped", ("fleet",),
+        )
+        self.fleet_egress_frame_members = c(
+            "crdt_fleet_egress_frame_members_total",
+            "Member replicas carried by shipped FleetFrameMsg envelopes",
+            ("fleet",),
+        )
+        self.fleet_egress_seconds = h(
+            "crdt_fleet_egress_seconds",
+            "Batched egress tick wall time", ("fleet",),
+        )
         # batchable handlers for the two per-message hot families: the
         # grouped ingest path emits them via telemetry.execute_many, and
         # the batch form folds the whole group under ONE registry-lock
@@ -571,6 +597,7 @@ class MetricsBridge:
             (telemetry.CATCHUP_CHUNK, self._on_catchup_chunk),
             (telemetry.CATCHUP_DONE, self._on_catchup_done),
             (telemetry.FLEET_DISPATCH, self._on_fleet_dispatch),
+            (telemetry.FLEET_EGRESS, self._on_fleet_egress),
         ]
 
     def attach(self) -> "MetricsBridge":
@@ -709,6 +736,17 @@ class MetricsBridge:
             self.fleet_occupancy._observe_held(lb, g("replicas", 0))
             self.fleet_rows._inc_held(lb, g("rows", 0))
             self.fleet_padded_rows._inc_held(lb, g("padded_rows", 0))
+
+    def _on_fleet_egress(self, _event, meas, meta) -> None:
+        lb = (self._s(meta.get("fleet")),)
+        g = meas.get
+        with self._lock:
+            self.fleet_egress_ticks._inc_held(lb)
+            self.fleet_egress_dispatches._inc_held(lb, g("dispatches", 0))
+            self.fleet_egress_members._observe_held(lb, g("members", 0))
+            self.fleet_egress_frames._inc_held(lb, g("frames", 0))
+            self.fleet_egress_frame_members._inc_held(lb, g("frame_members", 0))
+            self.fleet_egress_seconds._observe_held(lb, g("duration_s", 0.0))
 
 
 # ----------------------------------------------------------------------
@@ -980,6 +1018,18 @@ class Observability:
         self._g_fleet_ticks = g(
             "crdt_fleet_ticks", "Fleet scheduler ticks (polled)", ("fleet",)
         )
+        self._g_fleet_egress_mpf = g(
+            "crdt_fleet_egress_members_per_frame",
+            "Mean member replicas per shipped FleetFrameMsg", ("fleet",),
+        )
+        self._g_fleet_egress_fpt = g(
+            "crdt_fleet_egress_frames_per_tick",
+            "Mean FleetFrameMsg envelopes per egress tick", ("fleet",),
+        )
+        self._g_fleet_egress_occ = g(
+            "crdt_fleet_egress_bucket_occupancy",
+            "Mean members per batched egress extraction bucket", ("fleet",),
+        )
         self._c_drained = self.registry.counter(
             "crdt_drained_messages_total",
             "Messages drained by the replica event loop", ("name",),
@@ -1084,6 +1134,10 @@ class Observability:
             self._g_fleet_occupancy.set(st["avg_occupancy"], fleet_lb)
             self._g_fleet_fill.set(st["ragged_fill_ratio"], fleet_lb)
             self._g_fleet_ticks.set(st["ticks"], fleet_lb)
+            eg = st["egress"]
+            self._g_fleet_egress_mpf.set(eg["members_per_frame"], fleet_lb)
+            self._g_fleet_egress_fpt.set(eg["frames_per_tick"], fleet_lb)
+            self._g_fleet_egress_occ.set(eg["avg_bucket_occupancy"], fleet_lb)
 
         fleet._obs_collector = collect
         self.registry.register_collector(collect)
@@ -1096,6 +1150,8 @@ class Observability:
             fleet._obs_collector = None
         for gauge in (
             self._g_fleet_occupancy, self._g_fleet_fill, self._g_fleet_ticks,
+            self._g_fleet_egress_mpf, self._g_fleet_egress_fpt,
+            self._g_fleet_egress_occ,
         ):
             # same contract as unregister_replica: a stopped fleet must
             # not scrape as a stale last value forever

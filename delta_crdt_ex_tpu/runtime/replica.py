@@ -108,6 +108,81 @@ class _LazyLevels:
         return h
 
 
+class _StackedLevels:
+    """Digest-tree levels for a whole fleet egress bucket, built by ONE
+    vmapped dispatch (``transition.fleet_tree_from_leaves``): level j is
+    ``[N, 2^j]``. Host materialisation is per LEVEL and shared by every
+    member lane — the opener path prefetches the top
+    ``levels_per_round`` levels (tiny: 2^0..2^8 digests per lane) in
+    one batched transfer, and a deep receive-side walk by any one
+    member materialises that level for all of them."""
+
+    __slots__ = ("_dev", "_host")
+
+    def __init__(self, levels: list) -> None:
+        self._dev = levels
+        self._host: list[np.ndarray | None] = [None] * len(levels)
+
+    def __len__(self) -> int:
+        return len(self._dev)
+
+    def prefetch(self, upto: int) -> None:
+        """Materialise levels ``0..upto`` (inclusive, clamped) with one
+        batched device fetch — the opener's whole working set."""
+        upto = min(upto, len(self._dev) - 1)
+        want = [j for j in range(upto + 1) if self._host[j] is None]
+        if not want:
+            return
+        got = jax.device_get([self._dev[j] for j in want])
+        for j, arr in zip(want, got):
+            self._host[j] = np.asarray(arr)
+
+    def lane_level(self, level: int, lane: int) -> np.ndarray:
+        h = self._host[level]
+        if h is None:
+            h = self._host[level] = np.asarray(self._dev[level])
+        return h[lane]
+
+
+class _LaneLevels:
+    """One member's view of a :class:`_StackedLevels` — duck-compatible
+    with :class:`_LazyLevels` (the walk and ``make_blocks`` only index
+    and ``len()``), bit-identical to the member's solo tree."""
+
+    __slots__ = ("_stack", "_lane")
+
+    def __init__(self, stack: _StackedLevels, lane: int) -> None:
+        self._stack = stack
+        self._lane = lane
+
+    def __len__(self) -> int:
+        return len(self._stack)
+
+    def __getitem__(self, level: int) -> np.ndarray:
+        return self._stack.lane_level(level, self._lane)
+
+
+class _PushJob:
+    """One planned eager-push extraction (``_eager_jobs``): the rows /
+    interval bounds to gather and the peers the resulting slice fans
+    out to. Planning, extraction, and emission are separate steps so
+    the fleet can run many members' extractions as ONE vmapped
+    dispatch between a member's plan and its emit — the slice is a pure
+    function of ``(state snapshot, rows, lo)``, so batched and solo
+    extraction are interchangeable bit-for-bit."""
+
+    __slots__ = ("kind", "rows", "lo", "pending", "peers", "advance", "new_cursor")
+
+    def __init__(self, kind, rows, lo, pending, peers, advance=None, new_cursor=0):
+        self.kind = kind  # "delta" (own-interval) | "rows" (kill-touched)
+        self.rows = rows  # int32[U] bucket rows, -1 pads (wire tier)
+        self.lo = lo  # uint32[U] interval lower bounds ("delta" only)
+        self.pending = pending  # int64-able real bucket indices
+        self.peers = peers  # "delta": [(addr, cursor array)]; "rows": [addr]
+        self.advance = advance  # "delta": own counters to advance cursors to
+        self.new_cursor = new_cursor  # "rows": touch-seq cursor after this push
+
+
 class Replica:
     def __init__(
         self,
@@ -1485,20 +1560,28 @@ class Replica:
             self._flush()
             self._monitor_neighbours()
             self._push_deltas()
-            opened = 0
-            for n in list(self._monitors):
-                if n == self.addr:
-                    continue
-                opened += bool(self._open_walk(n))
-            if opened:
-                self._flight("sync_open", peers=opened, seq=self._seq)
-                if self._lag is not None:
-                    # the origin's propagation-round clock: one round per
-                    # tick that actually opened walks (lag samples report
-                    # how many of these they waited through)
-                    self._lag.note_round(self.addr)
+            self._open_walks()
 
-    def _open_walk(self, n) -> bool:
+    def _open_walks(self, send=None) -> None:
+        """Open digest-walk rounds toward every monitored neighbour —
+        the tail of :meth:`sync_to_all`, factored out so the fleet's
+        batched sync tick (which pre-builds trees and pre-extracts
+        pushes across members) runs the identical bookkeeping. Caller
+        holds the lock."""
+        opened = 0
+        for n in list(self._monitors):
+            if n == self.addr:
+                continue
+            opened += bool(self._open_walk(n, send))
+        if opened:
+            self._flight("sync_open", peers=opened, seq=self._seq)
+            if self._lag is not None:
+                # the origin's propagation-round clock: one round per
+                # tick that actually opened walks (lag samples report
+                # how many of these they waited through)
+                self._lag.note_round(self.addr)
+
+    def _open_walk(self, n, send=None) -> bool:
         """Open one digest-walk round toward ``n`` (the classic
         ``DiffMsg`` opener, factored out so the log-shipping horizon
         fallback can start a walk outside the periodic tick). Respects
@@ -1522,7 +1605,7 @@ class Replica:
             originator=self.addr, frm=self.addr, to=n, level=0, idx=root,
             blocks=blocks, seq=self._seq, log_horizon=horizon,
         )
-        if self.transport.send(n, msg):
+        if (self.transport.send if send is None else send)(n, msg):
             self._outstanding[n] = now + self.sync_timeout
             # ack watermark bookkeeping: an eventual AckMsg for
             # this round proves the peer held everything we had
@@ -1537,7 +1620,7 @@ class Replica:
         logger.debug("tried to sync with a dead neighbour: %r", n)
         return False
 
-    def _push_deltas(self) -> None:
+    def _push_deltas(self, send=None) -> None:
         """Eagerly push this replica's own fresh dots to each neighbour as
         delta-interval slices (Almeida et al.'s delta mode): per neighbour
         a per-bucket cursor tracks the highest own counter already pushed;
@@ -1545,17 +1628,32 @@ class Replica:
         interval directly — O(delta), no walk rounds. A lost push leaves
         the next one non-contiguous at the receiver, which answers with a
         ``GetDiffMsg`` repair (see ``_handle_entries_inner``). Bounded by
-        ``max_sync_size`` bucket rows per neighbour per tick."""
+        ``max_sync_size`` bucket rows per neighbour per tick.
+
+        Split into plan (``_eager_jobs``) / extract / emit steps so the
+        fleet's batched sync tick can run many members' extractions as
+        ONE vmapped dispatch — this solo form IS plan+extract+emit in
+        sequence, so the two paths share every line of bookkeeping."""
+        for job in self._eager_jobs():
+            self._emit_push_job(job, self._extract_push_job(job), send)
+
+    def _eager_jobs(self) -> list:
+        """Plan this tick's eager-push extractions (caller holds the
+        lock): one ``_PushJob`` per neighbour cursor-group — in steady
+        state every cursor is identical, so one slice extraction +
+        payload gather fans out to all of them — plus the full-row jobs
+        for kill-touched rows (removes, clears and overwriting adds —
+        kills cannot ride an interval; oldest unique stamps first, so a
+        truncated push advances the cursor to exactly the last pushed
+        row)."""
+        jobs: list = []
         if not self.eager_deltas:
-            return
+            return jobs
         if self._own_ctr_cache is None:
             self._own_ctr_cache = np.asarray(self.state.ctx_max[:, self.self_slot])
         own = self._own_ctr_cache
         limit = int(min(self.max_sync_size, self.num_buckets))
 
-        # group neighbours by cursor value: in steady state every cursor
-        # is identical, so the slice extraction + payload gather happen
-        # once and the same message body fans out to all of them
         groups: dict[bytes, list] = {}
         for n in list(self._monitors):
             if n == self.addr:
@@ -1565,7 +1663,6 @@ class Replica:
                 cur = np.zeros(self.num_buckets, np.uint32)
                 self._push_cursor[n] = cur
             groups.setdefault(cur.tobytes(), []).append((n, cur))
-
         for members in groups.values():
             cur0 = members[0][1]
             pending = np.nonzero(own > cur0)[0]
@@ -1576,33 +1673,15 @@ class Replica:
             rows[: len(pending)] = pending
             lo = np.zeros(len(rows), np.uint32)
             lo[: len(pending)] = cur0[pending]
-            sl = self.model.extract_own_delta(
-                self.state,
-                jnp.asarray(rows),
-                jnp.int32(self.self_slot),
-                jnp.uint64(self.node_id),
-                jnp.asarray(lo),
+            # the cursor targets are pinned at plan time: a concurrent
+            # flush between a batched extract and the emit can only ADD
+            # dots, and an advance to the planned values undershoots —
+            # the next tick re-covers (idempotent), never overshoots
+            jobs.append(
+                _PushJob("delta", rows, lo, pending, members,
+                         advance=own[pending].copy())
             )
-            bodies, payloads = self._slice_bodies(
-                sl, rows, [n for n, _cur in members]
-            )
-            for n, cur in members:
-                msg = sync_proto.EntriesMsg(
-                    originator=self.addr,
-                    frm=self.addr,
-                    to=n,
-                    buckets=pending.astype(np.int64),
-                    arrays=bodies[n],
-                    payloads=payloads,
-                )
-                if self.transport.send(n, msg):
-                    cur[pending] = own[pending]
 
-        # full-row pushes for kill-touched rows (removes, clears and
-        # overwriting adds — kills cannot ride an interval). Oldest unique
-        # stamps first, so a truncated push advances the cursor to exactly
-        # the last pushed row; neighbours with equal cursors share one
-        # extraction like the delta leg above.
         rm_groups: dict[int, list] = {}
         for n in list(self._monitors):
             if n == self.addr:
@@ -1617,19 +1696,54 @@ class Replica:
             new_cursor = int(self._row_touch_seq[pend[-1]])
             rows = np.full(_wire(max(len(pend), 1)), -1, np.int32)
             rows[: len(pend)] = pend
-            sl = self.model.extract_rows(self.state, jnp.asarray(rows))
-            bodies, payloads = self._slice_bodies(sl, rows, members)
-            for n in members:
-                msg = sync_proto.EntriesMsg(
-                    originator=self.addr,
-                    frm=self.addr,
-                    to=n,
-                    buckets=pend.astype(np.int64),
-                    arrays=bodies[n],
-                    payloads=payloads,
-                )
-                if self.transport.send(n, msg):
-                    self._rm_cursor[n] = new_cursor
+            jobs.append(
+                _PushJob("rows", rows, None, pend, members,
+                         new_cursor=new_cursor)
+            )
+        return jobs
+
+    def _extract_push_job(self, job: "_PushJob"):
+        """Solo (per-replica) extraction of one planned push job — the
+        fleet substitutes the matching lane of one vmapped extraction,
+        bit-for-bit the same slice."""
+        if job.kind == "delta":
+            return self.model.extract_own_delta(
+                self.state,
+                jnp.asarray(job.rows),
+                jnp.int32(self.self_slot),
+                jnp.uint64(self.node_id),
+                jnp.asarray(job.lo),
+            )
+        return self.model.extract_rows(self.state, jnp.asarray(job.rows))
+
+    def _emit_push_job(self, job: "_PushJob", sl, send=None) -> None:
+        """Fan one extracted push slice out to the job's peers and
+        advance their cursors on successful sends — THE shared emission
+        tail of the solo and fleet egress paths (caller holds the
+        lock). ``sl`` may be device-resident (solo) or an already-
+        fetched host-form slice (fleet batched)."""
+        send = self.transport.send if send is None else send
+        if job.kind == "delta":
+            peers = [n for n, _cur in job.peers]
+        else:
+            peers = job.peers
+        bodies, payloads = self._slice_bodies(sl, job.rows, peers)
+        buckets = job.pending.astype(np.int64)
+        for p in job.peers:
+            n = p[0] if job.kind == "delta" else p
+            msg = sync_proto.EntriesMsg(
+                originator=self.addr,
+                frm=self.addr,
+                to=n,
+                buckets=buckets,
+                arrays=bodies[n],
+                payloads=payloads,
+            )
+            if send(n, msg):
+                if job.kind == "delta":
+                    p[1][job.pending] = job.advance
+                else:
+                    self._rm_cursor[n] = job.new_cursor
 
     def _monitor_neighbours(self) -> None:
         for n in self._neighbours:
@@ -1668,6 +1782,8 @@ class Replica:
                     self._ack_seq[msg.clear_addr] = max(
                         self._ack_seq.get(msg.clear_addr, 0), open_seq
                     )
+            elif isinstance(msg, sync_proto.FleetFrameMsg):
+                self._handle_fleet_frame(msg)
             elif isinstance(msg, Down):
                 self._monitors.discard(msg.addr)
                 self._outstanding.pop(msg.addr, None)
@@ -1682,6 +1798,19 @@ class Replica:
                 self._catchup.pop(msg.addr, None)
             else:
                 raise TypeError(f"unknown message: {msg!r}")
+
+    def _handle_fleet_frame(self, msg: sync_proto.FleetFrameMsg) -> None:
+        """Fan a fleet egress envelope out (ISSUE 10). The TCP transport
+        decodes ``_FLEETF`` frames before delivery, so this arm is the
+        fallback for transports that hand the envelope to a mailbox
+        whole: entries addressed to this replica dispatch through the
+        normal ladder (the RLock makes the recursive :meth:`handle`
+        re-entry a no-op acquire), everything else forwards unopened."""
+        for to, m in msg.entries:
+            if to == self.addr or to == self.name:
+                self.handle(m)
+            else:
+                self.transport.send(to, m)
 
     def _handle_diff(self, msg: sync_proto.DiffMsg) -> None:
         self._flush()
@@ -2742,6 +2871,13 @@ class Replica:
             self._tree = None
             self._read_cache = None
             self._read_cache_kh = None
+            # the adopted lane's ctx_max can include own-gid counters the
+            # cache predates (a peer relaying our dots back after a
+            # WAL-less restart reused our node id), and unlike the solo
+            # merge path the batched dispatch swaps the WHOLE state cell
+            # — drop the cursor-source cache so the next egress tick
+            # plans from the adopted lane, never a stale own column
+            self._own_ctr_cache = None
             self._fleet_dispatches += 1
             self._fleet_messages += len(msgs)
             self._commit_entries_group(msgs, offsets, counts_fn, dt)

@@ -169,6 +169,32 @@ class AckMsg:
     clear_addr: Hashable
 
 
+@dataclasses.dataclass
+class FleetFrameMsg:
+    """Fleet-wide egress envelope (ISSUE 10): one wire frame carrying
+    many fleet members' per-peer sync messages — eager-delta
+    ``EntriesMsg`` slices and ``DiffMsg`` openers — to a co-located
+    peer process, where the transport decodes it back into per-member
+    mailbox deliveries. ``entries`` is an ordered list of
+    ``(to_addr, message)`` pairs; per-(sender, receiver) message order
+    is the list order, exactly what per-member sends would produce.
+
+    This is a negotiated capability (the TCP transport's ``_FLEETF``
+    frame kind behind the ``_FEAT_FLEET`` HELLO bit): a peer that never
+    advertised it receives plain per-member frames instead, so
+    mixed-version clusters keep converging message-for-message. Flat
+    gossip rides it today; it is the frame hierarchical anti-entropy
+    (ROADMAP) will coalesce on — an intermediate hop can rewrite
+    ``entries`` without touching the inner messages.
+
+    A replica handed the whole envelope (a transport without
+    frame-level decode) fans it out itself: entries addressed to the
+    replica dispatch locally, everything else forwards."""
+
+    frm: Hashable  # sending process identity (diagnostics/tracing)
+    entries: list  # [(to_addr, message), ...] in send order
+
+
 def make_blocks(
     tree: list[np.ndarray], level: int, idx: np.ndarray, levels_per_round: int
 ) -> list[np.ndarray]:

@@ -37,6 +37,7 @@ import threading
 import time
 from typing import Any, Hashable
 
+from delta_crdt_ex_tpu.runtime import sync as sync_proto
 from delta_crdt_ex_tpu.runtime.transport import Down
 
 logger = logging.getLogger("delta_crdt_ex_tpu")
@@ -58,11 +59,18 @@ _MSGB = 5  # arrays side-channel: pickle-5 head + out-of-band buffers,
 # "cross-host wire"), so whole-frame pickle4+zlib loses to raw framing
 # at any link >= 1 Gb/s; sparse delta slices still compress 25x+ and the
 # per-buffer probe keeps that win.
+_FLEETF = 6  # fleet egress envelope (ISSUE 10): one frame carrying a
+# FleetFrameMsg — many fleet members' per-peer sync messages to one
+# co-located peer process, decoded back to per-member mailbox
+# deliveries here. _MSGB buffer framing inside; only sent to peers that
+# advertised _FEAT_FLEET (legacy peers get per-member frames instead,
+# so mixed-version clusters keep converging; see MIGRATING.md).
 
 _WIRE_VERSION = 1
 _FEAT_MSGZ = 1  # feature bit: peer accepts zlib-compressed _MSG frames
 _FEAT_MSGB = 2  # feature bit: peer accepts _MSGB array-buffer frames
-_OUR_FEATURES = _FEAT_MSGZ | _FEAT_MSGB
+_FEAT_FLEET = 4  # feature bit: peer accepts _FLEETF fleet-frame envelopes
+_OUR_FEATURES = _FEAT_MSGZ | _FEAT_MSGB | _FEAT_FLEET
 
 #: how long the HELLO waiter keeps reading for a late reply before giving
 #: up (several socket timeouts — a loaded peer may accept late; a legacy
@@ -234,6 +242,7 @@ def _start_hello_negotiation(conn: "_SenderConn") -> None:
                     if ln >= 3:
                         conn.accepts_z = bool(body[2] & _FEAT_MSGZ)
                         conn.accepts_b = bool(body[2] & _FEAT_MSGB)
+                        conn.accepts_f = bool(body[2] & _FEAT_FLEET)
                     return  # a short/malformed HELLO concludes feature-less
                 # other frame kinds on an outbound conn are unexpected —
                 # skip and keep waiting for the HELLO
@@ -268,6 +277,8 @@ class _SenderConn:
         self.accepts_z = accepts_z
         #: negotiated via HELLO: whether this peer accepts _MSGB frames
         self.accepts_b = False
+        #: negotiated via HELLO: whether this peer accepts _FLEETF frames
+        self.accepts_f = False
         self._q_bytes = 0  # approximate: adjusted under _dead_lock only
         self._q: queue.Queue = queue.Queue(maxsize=self.QUEUE_MAX)
         self._on_dead = on_dead
@@ -504,6 +515,13 @@ class TcpTransport:
                             k, p = _MSG, zlib.decompress(p)
                         elif k == _MSGB and not fresh.accepts_b:
                             k, p = _MSG, pickle.dumps(_decode_msgb(p), protocol=4)
+                        elif k == _FLEETF and not fresh.accepts_f:
+                            # unbundle the envelope for the downgraded
+                            # peer: one per-member frame per entry
+                            fm = _decode_msgb(p)
+                            for to, m in fm.entries:
+                                self._send_remote(to, (_MSG, to[0], m))
+                            continue
                         fresh.enqueue(k, p, attempt=1)
 
         conn = _SenderConn(sock, on_dead, on_sent=self._count_tx)
@@ -549,6 +567,45 @@ class TcpTransport:
     def _count_tx(self, n: int) -> None:
         with self._bytes_lock:
             self._tx_bytes += n
+
+    # -- fleet egress frames (ISSUE 10) ------------------------------------
+
+    def fleet_sink(self, addr: Hashable) -> "tuple | None":
+        """The fleet-frame aggregation key for ``addr``: its remote
+        endpoint when the pooled connection there negotiated
+        ``_FEAT_FLEET``, else ``None`` (per-member frames — a local
+        peer, a dead endpoint, a legacy peer, or a HELLO still in
+        flight). The fleet egress groups one tick's outbound sync
+        messages by this key and ships one :class:`~delta_crdt_ex_tpu.
+        runtime.sync.FleetFrameMsg` per endpoint."""
+        if not self._is_remote(addr):
+            return None
+        endpoint = addr[1]
+        conn = self._connect(endpoint)
+        if conn is None or not conn.accepts_f:
+            return None
+        return endpoint
+
+    def send_fleet_frame(self, endpoint: tuple, entries: list) -> bool:
+        """Ship one fleet egress envelope — many members' per-peer sync
+        messages in ONE ``_FLEETF`` frame — to a peer process. Falls
+        back to per-member sends when the connection renegotiated down
+        between :meth:`fleet_sink` and here (peer restarted on an older
+        build); the messages still flow, but the return is ``False`` so
+        callers' frame-aggregation accounting never reports an envelope
+        that did not actually ride the wire. ``True`` means the frame is
+        queued on the sender connection; a later drop (peer died
+        mid-flight) is healed by the periodic sync like any lost frame."""
+        conn = self._connect(endpoint)
+        if conn is None:
+            return False
+        if not conn.accepts_f:
+            for to, m in entries:
+                self.send(to, m)
+            return False
+        fm = sync_proto.FleetFrameMsg(frm=self.endpoint, entries=list(entries))
+        payload = _encode_msgb(fm, min_bytes=0)
+        return conn.enqueue(_FLEETF, payload)
 
     def queue_depth(self, addr: Hashable) -> int:
         """Queued messages in one LOCAL mailbox (the observability
@@ -703,6 +760,13 @@ class TcpTransport:
                 elif kind == _MSGB:
                     name, msg = _decode_msgb(payload)
                     self.send(name, msg)
+                elif kind == _FLEETF:
+                    # fleet egress envelope: decode back to per-member
+                    # mailbox deliveries, in send order (per-(sender,
+                    # receiver) ordering is exactly the per-member path's)
+                    fm = _decode_msgb(payload)
+                    for to, m in fm.entries:
+                        self.send(to, m)
                 elif not warned_unknown:
                     # once per connection: a misbehaving/newer peer
                     # streaming frames must not flood the log

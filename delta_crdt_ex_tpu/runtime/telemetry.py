@@ -23,6 +23,7 @@ WAL_RECOVER = ("delta_crdt", "wal", "recover")  # measurements: records, bytes, 
 CATCHUP_CHUNK = ("delta_crdt", "catchup", "chunk")  # measurements: records, rows, entries, bytes, duration_s; metadata: name, role ("server"|"client"), peer
 CATCHUP_DONE = ("delta_crdt", "catchup", "done")  # measurements: chunks, duration_s, horizon_fallback; metadata: name, peer
 FLEET_DISPATCH = ("delta_crdt", "fleet", "dispatch")  # measurements: replicas, lanes, messages, rows, padded_rows, duration_s; metadata: fleet
+FLEET_EGRESS = ("delta_crdt", "fleet", "egress")  # measurements: members, jobs_batched, jobs_solo, dispatches, frames, frame_members, duration_s; metadata: fleet
 
 def declared_events() -> tuple[tuple, ...]:
     """Every event tuple this module declares (the OBS001 contract:

@@ -78,6 +78,36 @@ def fleet_extract_rows(states, rows) -> binned_ops.RowSlice:
     return jax.vmap(binned_ops.extract_rows)(states, rows)
 
 
+def fleet_interval_slices(states, rows, self_slots, gid_selfs, lo) -> binned_ops.RowSlice:
+    """Batched own-writer delta-interval extraction (ISSUE 10): lane k
+    gathers its own alive entries with counter in ``(lo, ctx_max]`` per
+    bucket row — the fleet-wide eager-push (Almeida et al.'s delta
+    mode) in ONE dispatch instead of one ``extract_own_delta`` per
+    member. ``self_slots``/``gid_selfs`` are per-lane scalars; padding
+    lanes (rows all ``-1``) extract nothing."""
+    return jax.vmap(binned_ops.extract_own_delta)(
+        states, rows, self_slots, gid_selfs, lo
+    )
+
+
+def fleet_tree_from_leaves(leaves) -> list:
+    """Batched digest-tree build over stacked leaf digests ``[N, L]``:
+    one dispatch yields every member's levels (each ``[N, 2^j]``) —
+    the sync-tick tree rebuild without N per-member launches. Leaf
+    digests are backend-agnostic (the shared sync-index geometry), so
+    one form serves both stores."""
+    return jax.vmap(binned_ops.tree_from_leaves)(leaves)
+
+
+def fleet_own_ctr_columns(ctx_max, self_slots):
+    """uint32[N, L]: each lane's own-writer ``ctx_max`` column — the
+    eager-push cursor source (``Replica._own_ctr_cache``), refreshed
+    for a whole fleet in one gather + one host transfer instead of N
+    per-member column reads. Backend-agnostic: ``ctx_max`` is the
+    shared sync-index geometry."""
+    return jax.vmap(lambda cm, s: cm[:, s])(ctx_max, self_slots)
+
+
 def fleet_compact_rows(states: BinnedStore) -> BinnedStore:
     """Batched full repack + invariant rebuild, one dispatch for the
     whole stack."""
@@ -92,6 +122,9 @@ def fleet_winner_all(states: BinnedStore) -> binned_ops.RowWinners:
 jit_fleet_merge_rows = jax.jit(fleet_merge_rows)
 jit_fleet_row_apply = jax.jit(fleet_row_apply)
 jit_fleet_extract_rows = jax.jit(fleet_extract_rows)
+jit_fleet_interval_slices = jax.jit(fleet_interval_slices)
+jit_fleet_tree_from_leaves = jax.jit(fleet_tree_from_leaves)
+jit_fleet_own_ctr_columns = jax.jit(fleet_own_ctr_columns)
 jit_fleet_compact_rows = jax.jit(fleet_compact_rows)
 jit_fleet_winner_all = jax.jit(fleet_winner_all)
 
@@ -122,19 +155,78 @@ def fleet_hash_winner_all(states: HashStore):
     return jax.vmap(hash_ops.winner_all)(states)
 
 
+def fleet_hash_row_counts(states: HashStore, rows):
+    """Batched dense-extraction sizing pass: alive entries per requested
+    sync row, every lane in one dispatch (``int32[N, U]``) — the host
+    reads it back ONCE and tiers each member's dense lane width pow2 so
+    ragged members still share one packed-extraction compile."""
+    return jax.vmap(hash_ops.row_counts)(states, rows)
+
+
+def fleet_hash_own_delta_counts(states: HashStore, rows, self_slots, lo):
+    """Batched sizing pass for the own-writer delta-interval extraction
+    (``int32[N, U]`` entries per row in ``(lo, ∞)``)."""
+    return jax.vmap(hash_ops.own_delta_counts)(states, rows, self_slots, lo)
+
+
+def fleet_hash_extract_rows(states: HashStore, rows, lanes: int) -> binned_ops.RowSlice:
+    """Batched dense full-row extraction over stacked hash stores:
+    ``lanes`` is the bucket-wide pow2 tier (the max of the members'
+    own dense tiers); each member's solo-tier slice is the leading
+    ``[:, :member_lanes]`` columns of its lane, bit-for-bit."""
+    return jax.vmap(lambda st, r: hash_ops.extract_rows_packed(st, r, lanes))(
+        states, rows
+    )
+
+
+def fleet_hash_interval_slices(
+    states: HashStore, rows, self_slots, gid_selfs, lo, lanes: int
+) -> binned_ops.RowSlice:
+    """Batched dense own-writer delta-interval extraction, hash
+    backend (the ``fleet_interval_slices`` shape with a bucket-wide
+    static dense lane tier)."""
+    return jax.vmap(
+        lambda st, r, ss, gs, lo_: hash_ops.extract_own_delta_packed(
+            st, r, ss, gs, lo_, lanes
+        )
+    )(states, rows, self_slots, gid_selfs, lo)
+
+
 jit_fleet_hash_merge_rows = jax.jit(fleet_hash_merge_rows)
 jit_fleet_hash_row_apply = jax.jit(fleet_hash_row_apply)
 jit_fleet_hash_winner_all = jax.jit(fleet_hash_winner_all)
+jit_fleet_hash_row_counts = jax.jit(fleet_hash_row_counts)
+jit_fleet_hash_own_delta_counts = jax.jit(fleet_hash_own_delta_counts)
+jit_fleet_hash_extract_rows = jax.jit(
+    fleet_hash_extract_rows, static_argnames=("lanes",)
+)
+jit_fleet_hash_interval_slices = jax.jit(
+    fleet_hash_interval_slices, static_argnames=("lanes",)
+)
 
 
 # ---------------------------------------------------------------------------
 # stacking (pure pytree shuffles — no host round trips)
 
 
+def stack_pytrees(*trees):
+    """Stack per-replica pytrees (identical geometry) along a new
+    leading replica axis. Jit this (``jit_stack_pytrees``) on hot
+    paths: eager ``jnp.stack`` pays per-operand dispatch overhead
+    (expand_dims + concat tracing per member — the dominant cost of
+    restacking a 256-member bucket), while the jitted form compiles to
+    ONE cached executable per (member count, geometry). Works on whole
+    state pytrees and on bare arrays (leaf digests, ctx tables) alike."""
+    return jax.tree.map(lambda *xs: jax.numpy.stack(xs), *trees)
+
+
+jit_stack_pytrees = jax.jit(stack_pytrees)
+
+
 def stack_states(states: list) -> BinnedStore:
-    """Stack per-replica states (identical geometry) along a new leading
-    replica axis — the fleet's resident form."""
-    return jax.tree.map(lambda *xs: jax.numpy.stack(xs), *states)
+    """Stack per-replica states along a new leading replica axis — the
+    fleet's resident form (:func:`stack_pytrees` over a list)."""
+    return stack_pytrees(*states)
 
 
 def index_state(stacked: BinnedStore, lane: int) -> BinnedStore:

@@ -1683,6 +1683,42 @@ def test_mutation_host_sync_in_fleet_transition_is_caught():
     )
 
 
+def test_mutation_host_sync_in_fleet_egress_extraction_is_caught():
+    """Acceptance (ISSUE 10): an injected ``.item()`` in the batched
+    egress extraction (``fleet_extract_rows``) turns the gate red
+    (SYNC001) — the new egress functions are jit entry roots by the
+    same module contract as the merge forms."""
+    rel = f"{PKG}/runtime/transition.py"
+    anchor = "    return jax.vmap(binned_ops.extract_rows)(states, rows)"
+    assert anchor in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(
+            anchor, "    _n = rows.sum().item()\n" + anchor, 1
+        ),
+    )
+    assert any(
+        f.rule == "SYNC001" and f.path.endswith("runtime/transition.py")
+        for f in new
+    )
+
+
+def test_mutation_fleet_frame_wire_drift_is_caught():
+    """Acceptance (ISSUE 10): FleetFrameMsg is manifest-locked — adding
+    a wire field without ``--write-protocol-manifest`` turns the gate
+    red (WIRE005), exactly the reviewed-bump workflow this PR used to
+    land the message."""
+    rel = f"{PKG}/runtime/sync.py"
+    anchor = "    entries: list  # [(to_addr, message), ...] in send order"
+    assert anchor in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel, lambda s: s.replace(anchor, anchor + "\n    hops: int = 0", 1)
+    )
+    assert any(
+        f.rule == "WIRE005" and "FleetFrameMsg" in f.message for f in new
+    )
+
+
 def test_mutation_impure_fleet_transition_is_caught():
     """An in-place argument mutation (PURE001) or a clock read
     (PURE003) injected into the fleet merge transition turns the gate

@@ -181,6 +181,11 @@ def test_stack_entry_slices_pads_ragged_writer_tables():
 
 
 def _mk_sender(transport, clock, i, **opts):
+    # in-flight sync slots must not expire mid-test: a wall-clock expiry
+    # landing between the fleet drain and the solo twins' loop on a
+    # loaded host would re-open a walk toward one twin only and fail the
+    # stream-parity asserts spuriously
+    opts.setdefault("sync_timeout", 600.0)
     return start_link(
         AWLWWMap,
         threaded=False,

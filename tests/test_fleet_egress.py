@@ -1,0 +1,637 @@
+"""Batched fleet egress (ISSUE 10): vmapped sync ticks and multi-member
+wire frames must be OBSERVABLY IDENTICAL to the per-member loop —
+bit-for-bit wire bytes, opener streams, and cursor state — while
+launching one extraction/tree dispatch per shape bucket instead of one
+per member, and (over TCP) shipping many members' slices in one
+``FleetFrameMsg`` frame.
+
+Covers the pure-kernel lane parity (vmapped tree build + extraction ==
+solo, BOTH backends), seeded randomized fleet-vs-solo parity on full
+bidirectional gossip (state bits, wire streams, ack bookkeeping), the
+``FleetFrameMsg`` TCP codec roundtrip + mixed-version per-message
+fallback, the ragged-bucket fallback-to-solo legs, and the
+``_own_ctr_cache`` fleet-commit invalidation regression.
+"""
+
+import pickle
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from delta_crdt_ex_tpu import AWLWWMap
+from delta_crdt_ex_tpu.api import start_link
+from delta_crdt_ex_tpu.runtime import sync as sync_proto, transition
+from delta_crdt_ex_tpu.runtime.clock import LogicalClock
+from delta_crdt_ex_tpu.runtime.fleet import Fleet, _lane_slice
+from delta_crdt_ex_tpu.runtime.replica import (
+    _LaneLevels,
+    _LazyLevels,
+    _StackedLevels,
+)
+from delta_crdt_ex_tpu.runtime.transport import LocalTransport
+
+
+def _assert_state_bit_equal(r1, r2, ctx=""):
+    import jax
+
+    l1, _ = jax.tree.flatten(r1.state)
+    l2, _ = jax.tree.flatten(r2.state)
+    assert len(l1) == len(l2), ctx
+    for a, b in zip(l1, l2):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), ctx
+
+
+def _mk(transport, store="binned", **kw):
+    kw.setdefault("capacity", 256)
+    kw.setdefault("tree_depth", 4)
+    # in-flight sync slots must not expire mid-test: the parity drives
+    # clear them explicitly, and a wall-clock expiry landing between a
+    # fleet tick and its solo twin's loop (slow CI) would open a walk
+    # on one side only
+    kw.setdefault("sync_timeout", 600.0)
+    return start_link(
+        AWLWWMap, threaded=False, transport=transport, clock=LogicalClock(),
+        store=store, **kw,
+    )
+
+
+def _norm(msg):
+    """Address-free canonical form of one outbound sync message (the
+    twins differ only in names/addresses)."""
+    if isinstance(msg, sync_proto.EntriesMsg):
+        return (
+            "entries",
+            np.asarray(msg.buckets).tolist(),
+            {c: np.asarray(v).tolist() for c, v in msg.arrays.items()},
+            sorted(map(repr, msg.payloads.items())),
+        )
+    if isinstance(msg, sync_proto.DiffMsg):
+        return (
+            "diff", msg.level, np.asarray(msg.idx).tolist(),
+            [np.asarray(b).tolist() for b in msg.blocks], msg.seq,
+            msg.log_horizon,
+        )
+    if isinstance(msg, sync_proto.AckMsg):
+        return ("ack",)
+    if isinstance(msg, sync_proto.GetDiffMsg):
+        return ("get_diff", np.asarray(msg.buckets).tolist())
+    return (type(msg).__name__,)
+
+
+def _wire_bytes(msg):
+    """Pickled size of the address-free message body — the wire-byte
+    parity quantity (names/addresses differ between the twins)."""
+    if isinstance(msg, sync_proto.EntriesMsg):
+        return len(pickle.dumps(
+            (np.asarray(msg.buckets),
+             {c: np.asarray(v) for c, v in msg.arrays.items()},
+             msg.payloads),
+            protocol=4,
+        ))
+    if isinstance(msg, sync_proto.DiffMsg):
+        return len(pickle.dumps(
+            (msg.level, msg.idx, msg.blocks, msg.seq, msg.log_horizon),
+            protocol=4,
+        ))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# vmapped egress kernels: lane k == solo dispatch, bit-for-bit
+
+
+def test_fleet_tree_from_leaves_lane_parity():
+    rng = np.random.default_rng(7)
+    leaves = rng.integers(0, 2**32, size=(5, 16), dtype=np.uint32)
+    stacked = transition.jit_fleet_tree_from_leaves(jnp.asarray(leaves))
+    for lane in range(5):
+        solo = transition.binned_ops.tree_from_leaves(jnp.asarray(leaves[lane]))
+        assert len(stacked) == len(solo)
+        for j, lvl in enumerate(solo):
+            assert np.array_equal(np.asarray(stacked[j][lane]), np.asarray(lvl))
+
+
+def test_stacked_levels_lane_view_matches_lazy_levels():
+    rng = np.random.default_rng(8)
+    leaves = rng.integers(0, 2**32, size=(3, 16), dtype=np.uint32)
+    stacked = _StackedLevels(
+        transition.jit_fleet_tree_from_leaves(jnp.asarray(leaves))
+    )
+    stacked.prefetch(2)
+    for lane in range(3):
+        solo = _LazyLevels(
+            transition.binned_ops.tree_from_leaves(jnp.asarray(leaves[lane]))
+        )
+        view = _LaneLevels(stacked, lane)
+        assert len(view) == len(solo)
+        for j in range(len(solo)):
+            assert np.array_equal(view[j], solo[j])
+
+
+@pytest.mark.parametrize("store", ["binned", "hash"])
+def test_fleet_extraction_lane_parity(store):
+    """Batched interval/full-row extraction == the member's own solo
+    extraction bit-for-bit, both backends, including the hash store's
+    per-member dense-tier trim (``_lane_slice``)."""
+    transport = LocalTransport()
+    n = 4
+    reps = [
+        _mk(transport, store=store, name=f"x{store}{i}", node_id=50 + i)
+        for i in range(n)
+    ]
+    for i, r in enumerate(reps):
+        for j in range(1 + 3 * i):  # ragged content: distinct dense tiers
+            r.mutate("add", [i * 100 + j, j])
+    model = reps[0].model
+    states = [r.state for r in reps]
+    stacked = transition.stack_states(states)
+
+    u = 16
+    rows = np.full((n, u), -1, np.int32)
+    lo = np.zeros((n, u), np.uint32)
+    for i, r in enumerate(reps):
+        own = np.asarray(r.state.ctx_max[:, r.self_slot])
+        pend = np.nonzero(own)[0][:u]
+        rows[i, : len(pend)] = pend
+    slots = np.asarray([r.self_slot for r in reps], np.int32)
+    gids = np.asarray([r.node_id for r in reps], np.uint64)
+
+    sl, tiers = model.fleet_extract_own_delta(
+        stacked, jnp.asarray(rows), jnp.asarray(slots), jnp.asarray(gids),
+        jnp.asarray(lo),
+    )
+    import jax
+
+    host = jax.device_get(sl)
+    for i, r in enumerate(reps):
+        solo = r.model.extract_own_delta(
+            r.state, jnp.asarray(rows[i]), jnp.int32(r.self_slot),
+            jnp.uint64(r.node_id), jnp.asarray(lo[i]),
+        )
+        lane = _lane_slice(
+            host, i, rows[i], None if tiers is None else tiers[i]
+        )
+        for c in type(solo)._fields:
+            sv = np.asarray(getattr(solo, c))
+            lv = np.asarray(getattr(lane, c))
+            assert sv.shape == lv.shape, (store, i, c)
+            assert np.array_equal(sv, lv), (store, i, c)
+
+    sl2, tiers2 = model.fleet_extract_rows(stacked, jnp.asarray(rows))
+    host2 = jax.device_get(sl2)
+    for i, r in enumerate(reps):
+        solo = r.model.extract_rows(r.state, jnp.asarray(rows[i]))
+        lane = _lane_slice(
+            host2, i, rows[i], None if tiers2 is None else tiers2[i]
+        )
+        for c in type(solo)._fields:
+            assert np.array_equal(
+                np.asarray(getattr(solo, c)), np.asarray(getattr(lane, c))
+            ), (store, i, c)
+
+
+def test_fleet_own_ctr_columns():
+    rng = np.random.default_rng(9)
+    cm = rng.integers(0, 1000, size=(3, 16, 8)).astype(np.uint32)
+    slots = np.asarray([0, 3, 7], np.int32)
+    cols = np.asarray(
+        transition.jit_fleet_own_ctr_columns(jnp.asarray(cm), jnp.asarray(slots))
+    )
+    for k in range(3):
+        assert np.array_equal(cols[k], cm[k, :, slots[k]])
+
+
+# ---------------------------------------------------------------------------
+# runtime egress parity: batched sync ticks == per-member loop
+
+
+def _twin_universes(store, n, tree_depth=4):
+    transport = LocalTransport()
+    fleet_members = [
+        _mk(transport, store=store, name=f"ef{store}{n}_{i}", node_id=100 + i,
+            tree_depth=tree_depth)
+        for i in range(n)
+    ]
+    solos = [
+        _mk(transport, store=store, name=f"eo{store}{n}_{i}", node_id=100 + i,
+            tree_depth=tree_depth)
+        for i in range(n)
+    ]
+    frecv = [
+        _mk(transport, store=store, name=f"efr{store}{n}_{i}", node_id=900 + i,
+            tree_depth=tree_depth)
+        for i in range(n)
+    ]
+    orecv = [
+        _mk(transport, store=store, name=f"eor{store}{n}_{i}", node_id=900 + i,
+            tree_depth=tree_depth)
+        for i in range(n)
+    ]
+    for i in range(n):
+        fleet_members[i].set_neighbours([frecv[i]])
+        solos[i].set_neighbours([orecv[i]])
+    return transport, fleet_members, solos, frecv, orecv
+
+
+@pytest.mark.parametrize("store", ["binned", "hash"])
+def test_egress_streams_bit_parity(store):
+    """One-directional egress: the receivers' drained message streams —
+    eager-delta pushes, full-row (remove) pushes, walk openers — are
+    canonically identical and byte-for-byte equal in wire size."""
+    transport, fm, sm, frecv, orecv = _twin_universes(store, 4)
+    fleet = Fleet(fm)
+    fleet_bytes = solo_bytes = 0
+    for rnd in range(3):
+        for i in range(4):
+            for j in range(2 + i):
+                k = rnd * 1000 + i * 10 + j
+                fm[i].mutate("add", [k, k])
+                sm[i].mutate("add", [k, k])
+            if rnd == 1 and i % 2 == 0:
+                fm[i].mutate("remove", [rnd * 1000 + i * 10])
+                sm[i].mutate("remove", [rnd * 1000 + i * 10])
+        fleet.sync_tick()
+        for r in sm:
+            r.sync_to_all()
+        for i in range(4):
+            a_msgs = transport.drain(frecv[i].addr)
+            b_msgs = transport.drain(orecv[i].addr)
+            assert len(a_msgs) == len(b_msgs) > 0, (rnd, i)
+            for a, b in zip(a_msgs, b_msgs):
+                assert _norm(a) == _norm(b), (rnd, i, type(a).__name__)
+                fleet_bytes += _wire_bytes(a)
+                solo_bytes += _wire_bytes(b)
+            # clear the in-flight slots identically so every round opens
+            fm[i]._outstanding.clear()
+            fm[i]._sync_open_seq.clear()
+            sm[i]._outstanding.clear()
+            sm[i]._sync_open_seq.clear()
+        for i in range(4):
+            for va, vb in zip(
+                fm[i]._push_cursor.values(), sm[i]._push_cursor.values()
+            ):
+                assert np.array_equal(va, vb), (rnd, i)
+            assert list(fm[i]._rm_cursor.values()) == list(
+                sm[i]._rm_cursor.values()
+            ), (rnd, i)
+    assert fleet_bytes == solo_bytes > 0
+    eg = fleet.stats()["egress"]
+    assert eg["ticks"] == 3
+    assert eg["dispatches"] >= 1
+    assert eg["batched_jobs"] >= 1
+    assert eg["trees_batched"] >= 4
+
+
+@pytest.mark.parametrize("store", ["binned", "hash"])
+def test_egress_randomized_gossip_parity(store):
+    """Seeded randomized bidirectional gossip: fleet members sync via
+    batched ticks, solos via sync_to_all; receivers handle everything
+    (walk replies, repairs, acks). End state must be bit-identical,
+    receivers' inbound wire streams canonically equal, and the ack
+    bookkeeping (outstanding slots cleared by AckMsg) must match."""
+    rng = np.random.default_rng(1234 if store == "binned" else 4321)
+    transport, fm, sm, frecv, orecv = _twin_universes(store, 3)
+    fleet = Fleet(fm)
+    f_streams = [[] for _ in range(3)]
+    o_streams = [[] for _ in range(3)]
+    for rnd in range(6):
+        for i in range(3):
+            for _ in range(int(rng.integers(0, 4))):
+                k = int(rng.integers(0, 40))
+                v = int(rng.integers(0, 1000))
+                fm[i].mutate("add", [k, v])
+                sm[i].mutate("add", [k, v])
+            if rng.random() < 0.3:
+                k = int(rng.integers(0, 40))
+                fm[i].mutate("remove", [k])
+                sm[i].mutate("remove", [k])
+        fleet.sync_tick()
+        for r in sm:
+            r.sync_to_all()
+        # receivers process their mailboxes (generating acks/repairs),
+        # members process the back-traffic
+        for _ in range(4):
+            moved = 0
+            for i in range(3):
+                for m in transport.drain(frecv[i].addr):
+                    f_streams[i].append(_norm(m))
+                    frecv[i].handle(m)
+                    moved += 1
+                for m in transport.drain(orecv[i].addr):
+                    o_streams[i].append(_norm(m))
+                    orecv[i].handle(m)
+                    moved += 1
+            moved += fleet.tick()
+            for r in sm:
+                moved += r.process_pending()
+            if not moved:
+                break
+    assert f_streams == o_streams
+    for i in range(3):
+        assert fm[i]._seq == sm[i]._seq
+        _assert_state_bit_equal(fm[i], sm[i])
+        _assert_state_bit_equal(frecv[i], orecv[i])
+        assert fm[i].read() == sm[i].read()
+        assert len(fm[i]._outstanding) == len(sm[i]._outstanding)
+
+
+def test_ragged_bucket_falls_back_to_solo():
+    """Members with incompatible shapes (different tree depths) cannot
+    share a bucket: singleton buckets extract solo, still bit-identical
+    to the per-member loop."""
+    transport = LocalTransport()
+    fa = _mk(transport, name="rg_f0", node_id=100, tree_depth=4)
+    fb = _mk(transport, name="rg_f1", node_id=101, tree_depth=5)
+    oa = _mk(transport, name="rg_o0", node_id=100, tree_depth=4)
+    ob = _mk(transport, name="rg_o1", node_id=101, tree_depth=5)
+    ra = _mk(transport, name="rg_ra", node_id=900, tree_depth=4)
+    rb = _mk(transport, name="rg_rb", node_id=901, tree_depth=5)
+    sa = _mk(transport, name="rg_sa", node_id=900, tree_depth=4)
+    sb = _mk(transport, name="rg_sb", node_id=901, tree_depth=5)
+    fa.set_neighbours([ra])
+    fb.set_neighbours([rb])
+    oa.set_neighbours([sa])
+    ob.set_neighbours([sb])
+    fleet = Fleet([fa, fb])
+    for rep in (fa, fb, oa, ob):
+        rep.mutate("add", [1, 1])
+        rep.mutate("add", [2, 2])
+    fleet.sync_tick()
+    oa.sync_to_all()
+    ob.sync_to_all()
+    for recv, srecv in ((ra, sa), (rb, sb)):
+        am = transport.drain(recv.addr)
+        bm = transport.drain(srecv.addr)
+        assert len(am) == len(bm) > 0
+        for a, b in zip(am, bm):
+            assert _norm(a) == _norm(b)
+    eg = fleet.stats()["egress"]
+    assert eg["solo_jobs"] >= 2  # both members' jobs were singleton buckets
+    assert eg["dispatches"] == 0
+
+
+def test_single_member_tick_uses_solo_path():
+    transport = LocalTransport()
+    f = _mk(transport, name="solo_f", node_id=100)
+    r = _mk(transport, name="solo_r", node_id=900)
+    f.set_neighbours([r])
+    fleet = Fleet([f])
+    f.mutate("add", [1, 1])
+    fleet.sync_tick()
+    eg = fleet.stats()["egress"]
+    assert eg["solo_members"] == 1
+    assert eg["dispatches"] == 0
+    kinds = [type(m).__name__ for m in transport.drain(r.addr)]
+    assert "EntriesMsg" in kinds and "DiffMsg" in kinds
+
+
+def test_own_ctr_cache_invalidated_on_fleet_commit():
+    """Regression (ISSUE 10 satellite): a batched fleet commit must
+    drop the member's ``_own_ctr_cache`` — the adopted lane's ctx_max
+    can carry own-gid counters the cache predates, and a stale cache
+    would plan a stale cursor slice on the next batched egress."""
+    transport = LocalTransport()
+    senders = [_mk(transport, name=f"occ_s{i}", node_id=10 + i) for i in range(2)]
+    members = [_mk(transport, name=f"occ_f{i}", node_id=100 + i) for i in range(2)]
+    for i in range(2):
+        senders[i].set_neighbours([members[i]])
+    fleet = Fleet(members)
+    fleet.sync_tick()  # builds every member's cursor-source cache
+    for m in members:
+        assert m._own_ctr_cache is not None
+    for i, s in enumerate(senders):
+        s.mutate("add", [i, i])
+        s.sync_to_all()
+    # keep only the delta pushes so the tick is one batched dispatch
+    for m in members:
+        kept = [
+            x
+            for x in transport.drain(m.addr)
+            if isinstance(x, sync_proto.EntriesMsg)
+        ]
+        assert kept
+        for x in kept:
+            transport.send(m.addr, x)
+    fleet.tick()
+    st = fleet.stats()
+    assert st["dispatches"] >= 1 and st["fallbacks"]["singleton"] == 0
+    for m in members:
+        assert m._fleet_dispatches >= 1
+        assert m._own_ctr_cache is None  # the regression pin
+
+
+# ---------------------------------------------------------------------------
+# FleetFrameMsg: TCP codec roundtrip + fallbacks
+
+
+def _tcp_pair():
+    from delta_crdt_ex_tpu.runtime.tcp_transport import TcpTransport
+
+    return TcpTransport(), TcpTransport()
+
+
+def _await_hello(transport, endpoint, timeout=5.0):
+    """Wait until the pooled connection's HELLO negotiation lands."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with transport._lock:
+            conn = transport._conns.get(endpoint)
+        if conn is not None and conn.accepts_f:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_fleet_frame_tcp_roundtrip():
+    """Batched egress over TCP: one FleetFrameMsg per endpoint per tick
+    carries every member's slices + openers; the peer decodes it back
+    to per-member deliveries and converges."""
+    ta, tb = _tcp_pair()
+    try:
+        members = [
+            _mk(ta, name=f"tf_m{i}", node_id=100 + i) for i in range(3)
+        ]
+        peers = [_mk(tb, name=f"tf_p{i}", node_id=900 + i) for i in range(3)]
+        for i in range(3):
+            members[i].set_neighbours([(f"tf_p{i}", tb.endpoint)])
+        fleet = Fleet(members)
+        for i in range(3):
+            members[i].mutate("add", [i, i])
+        fleet.sync_tick()  # primes the connection (HELLO in flight)
+        assert _await_hello(ta, tb.endpoint)
+        for i in range(3):
+            members[i].mutate("add", [100 + i, 100 + i])
+        fleet.sync_tick()
+        deadline = time.monotonic() + 5.0
+        done = False
+        while time.monotonic() < deadline and not done:
+            for i in range(3):
+                for m in tb.drain(f"tf_p{i}"):
+                    peers[i].handle(m)
+            done = all(
+                peers[i].read().get(i) == i
+                and peers[i].read().get(100 + i) == 100 + i
+                for i in range(3)
+            )
+            time.sleep(0.02)
+        assert done, "peers did not converge over fleet frames"
+        eg = fleet.stats()["egress"]
+        assert eg["frames"] >= 1
+        assert eg["members_per_frame"] > 1.0  # many members, one frame
+    finally:
+        ta.close()
+        tb.close()
+
+
+def test_fleet_frame_mixed_version_fallback(monkeypatch):
+    """A peer that never advertised _FEAT_FLEET gets plain per-member
+    frames — mixed-version clusters converge message-for-message."""
+    from delta_crdt_ex_tpu.runtime import tcp_transport as tt
+
+    monkeypatch.setattr(
+        tt, "_OUR_FEATURES", tt._FEAT_MSGZ | tt._FEAT_MSGB
+    )  # the HELLO reply no longer claims fleet frames (a legacy build)
+    ta, tb = _tcp_pair()
+    try:
+        members = [
+            _mk(ta, name=f"mv_m{i}", node_id=100 + i) for i in range(2)
+        ]
+        peers = [_mk(tb, name=f"mv_p{i}", node_id=900 + i) for i in range(2)]
+        for i in range(2):
+            members[i].set_neighbours([(f"mv_p{i}", tb.endpoint)])
+        fleet = Fleet(members)
+        for rnd in range(2):
+            for i in range(2):
+                members[i].mutate("add", [rnd * 10 + i, i])
+            fleet.sync_tick()
+            time.sleep(0.3)
+        deadline = time.monotonic() + 5.0
+        done = False
+        while time.monotonic() < deadline and not done:
+            for i in range(2):
+                for m in tb.drain(f"mv_p{i}"):
+                    peers[i].handle(m)
+            done = all(
+                peers[i].read().get(i) == i
+                and peers[i].read().get(10 + i) == i
+                for i in range(2)
+            )
+            time.sleep(0.02)
+        assert done, "legacy peers did not converge per-message"
+        assert fleet.stats()["egress"]["frames"] == 0
+    finally:
+        ta.close()
+        tb.close()
+
+
+def test_send_fleet_frame_downgrades_per_member():
+    """``send_fleet_frame`` against a connection that renegotiated down
+    (accepts_f False) unbundles into per-member sends."""
+    ta, tb = _tcp_pair()
+    try:
+        sink = _mk(tb, name="dg_p", node_id=900)
+        ta.send(("dg_p", tb.endpoint), sync_proto.AckMsg(clear_addr="x"))
+        with ta._lock:
+            conn = ta._conns[tb.endpoint]
+        conn.accepts_f = False  # simulate a renegotiated-down peer
+        ok = ta.send_fleet_frame(
+            tb.endpoint,
+            [(("dg_p", tb.endpoint), sync_proto.AckMsg(clear_addr="y"))],
+        )
+        # the messages flow per-member, but no envelope rode the wire —
+        # the False return keeps frame-aggregation counters honest
+        assert ok is False
+        deadline = time.monotonic() + 5.0
+        got = []
+        while time.monotonic() < deadline and len(got) < 2:
+            got += tb.drain("dg_p")
+            time.sleep(0.02)
+        assert sorted(m.clear_addr for m in got) == ["x", "y"]
+        assert sink is not None
+    finally:
+        ta.close()
+        tb.close()
+
+
+def test_fleet_frame_replica_ladder_fallback():
+    """A FleetFrameMsg delivered whole to a replica mailbox (a
+    transport without frame-level decode) fans out through the
+    dispatch-ladder arm: own entries dispatch, others forward."""
+    transport = LocalTransport()
+    a = _mk(transport, name="lf_a", node_id=100)
+    b = _mk(transport, name="lf_b", node_id=101)
+    w = _mk(transport, name="lf_w", node_id=102)
+    for i in range(2):
+        w.mutate("add", [i, i])
+    own = np.asarray(w.state.ctx_max[:, w.self_slot])
+    rows = np.nonzero(own)[0]
+    entries = []
+    for to in (a.addr, b.addr):
+        arrays, payloads = w._extract_rows_wire(rows, None)
+        entries.append((
+            to,
+            sync_proto.EntriesMsg(
+                originator=w.addr, frm=w.addr, to=to,
+                buckets=rows.astype(np.int64), arrays=arrays,
+                payloads=payloads,
+            ),
+        ))
+    frame = sync_proto.FleetFrameMsg(frm=w.addr, entries=entries)
+    a.handle(frame)  # a's entry dispatches locally, b's forwards
+    for m in transport.drain(b.addr):
+        b.handle(m)
+    assert a.read() == {0: 0, 1: 1}
+    assert b.read() == {0: 0, 1: 1}
+
+
+def test_egress_observability_surface():
+    """FLEET_EGRESS rides the PR 9 plane: the bridge row folds the
+    event into ``crdt_fleet_egress_*`` counters/histograms, the polled
+    gauges (members per frame, frames per tick, bucket occupancy)
+    render at scrape time, and a stopped fleet's gauges disappear."""
+    from delta_crdt_ex_tpu.runtime.metrics import Observability
+
+    transport = LocalTransport()
+    plane = Observability()
+    members = [
+        _mk(transport, name=f"obsf{i}", node_id=100 + i) for i in range(2)
+    ]
+    recv = [_mk(transport, name=f"obsr{i}", node_id=900 + i) for i in range(2)]
+    for i in range(2):
+        members[i].set_neighbours([recv[i]])
+    fleet = Fleet(members, obs=plane)
+    try:
+        for i in range(2):
+            members[i].mutate("add", [i, i])
+        fleet.sync_tick()
+        out = plane.registry.render()
+        assert "crdt_fleet_egress_ticks_total" in out
+        assert "crdt_fleet_egress_members" in out
+        assert "crdt_fleet_egress_members_per_frame" in out
+        assert "crdt_fleet_egress_frames_per_tick" in out
+        assert "crdt_fleet_egress_bucket_occupancy" in out
+        eg = fleet.stats()["egress"]
+        assert eg["ticks"] >= 1
+    finally:
+        fleet.stop()
+        assert "crdt_fleet_egress_members_per_frame{" not in plane.registry.render()
+        plane.close()
+
+
+def test_fleet_frame_wire_manifest_locked():
+    """FleetFrameMsg is in the checked-in protocol manifest (the
+    reviewed WIRE005 bump this PR shipped)."""
+    import json
+    from pathlib import Path
+
+    manifest = json.loads(
+        (Path(__file__).resolve().parent.parent / "tools" / "crdtlint"
+         / "protocol_manifest.json").read_text()
+    )
+    msgs = manifest["packages"]["delta_crdt_ex_tpu"]["messages"]
+    assert "FleetFrameMsg" in msgs
+    assert [f for f, _t in msgs["FleetFrameMsg"]["fields"]] == [
+        "frm", "entries",
+    ]
