@@ -134,6 +134,49 @@ def _stage(name):
 _T_START = time.perf_counter()
 
 
+def _jit_steady_gate(tag: str, roots: tuple, before: dict, after: dict) -> dict:
+    """ISSUE 12 in-run gate: ZERO steady-state XLA compiles after warmup
+    on the named dispatch roots — the measured rounds must ride a warm
+    cache, or the speedup numbers are partly compile noise and the
+    shape-tier discipline (crdtlint SHAPE001/002) has regressed at
+    runtime. ``before`` is the compile-count snapshot taken entering
+    the LAST measured round; every earlier round is warmup."""
+    from delta_crdt_ex_tpu.utils import jitcache
+
+    assert jitcache.supported(), (
+        "jit tracing-cache counter unavailable: the steady-state "
+        "compile gate cannot run (it must not pass vacuously)"
+    )
+    moved = {
+        k: (before.get(k, 0), after.get(k, 0))
+        for k in roots
+        if after.get(k, 0) != before.get(k, 0)
+    }
+    assert not moved, f"{tag}: steady-state XLA compiles after warmup: {moved}"
+    return {k: after.get(k, 0) for k in roots if k in after}
+
+
+def _jit_metrics_probe(roots: tuple) -> None:
+    """Scrape a throwaway obs plane's /metrics and assert the compile
+    counter is visible for the given entry roots (the ISSUE 12
+    acceptance: the counter rides the export surface, not just the
+    in-process registry)."""
+    import urllib.request
+
+    from delta_crdt_ex_tpu.runtime.metrics import Observability
+
+    plane = Observability()
+    try:
+        server = plane.serve(port=0)
+        with urllib.request.urlopen(server.url + "/metrics", timeout=10) as r:
+            body = r.read().decode()
+        for root in roots:
+            needle = f'crdt_jit_compiles_total{{name="{root}"}}'
+            assert needle in body, f"{needle} missing from /metrics"
+    finally:
+        plane.close()
+
+
 def bench_tpu(seed=0, on_primary=None):
     import jax
     import jax.numpy as jnp
@@ -666,8 +709,15 @@ def bench_ingest():
             transport.send(r.addr, m)
         return len(msgs)
 
+    from delta_crdt_ex_tpu.utils import jitcache
+
     dts: dict[str, list[float]] = {"coalesced": [], "sequential": []}
+    pre_jit: dict = {}
     for rnd in range(rounds + 1):  # round 0 is jit/compile warmup
+        if rnd == rounds:
+            # entering the LAST measured round: every shape tier the
+            # steady state uses must already be compiled
+            pre_jit = jitcache.compile_counts()
         for i, s in enumerate(senders):
             for k in pools[i][rnd * keys_per_round:(rnd + 1) * keys_per_round]:
                 s.mutate("add", [k, k])
@@ -689,6 +739,16 @@ def bench_ingest():
             np.asarray(getattr(rc.state, c)), np.asarray(getattr(rs.state, c))
         ), f"coalesced/sequential state diverged: {c}"
     assert rc._seq == rs._seq
+
+    # ISSUE 12 gate: the hot merge/mutate/extract roots compiled NOTHING
+    # in the last round — zero steady-state compiles per shape bucket —
+    # and the counter is visible on the /metrics export surface
+    jit_counts = _jit_steady_gate(
+        "ingest",
+        ("merge_rows", "row_apply", "extract_own_delta"),
+        pre_jit, jitcache.compile_counts(),
+    )
+    _jit_metrics_probe(("merge_rows",))
 
     per_round = n_senders
     rate = lambda ds: per_round / statistics.median(ds)
@@ -714,6 +774,8 @@ def bench_ingest():
         "merges_per_dispatch": ing["merges_per_dispatch"],
         "coalesce_depth_hist": {str(k): v for k, v in ing["coalesce_depth_hist"].items()},
         "parity": "bit_for_bit_state_checked",
+        "jit_compiles": jit_counts,
+        "jit_steady_state": "zero_compiles_in_last_round",
         "neighbours": n_senders,
         "rounds": rounds,
         "keys_per_round": keys_per_round,
@@ -1083,8 +1145,15 @@ def bench_fleet():
         for i, s in enumerate(senders):
             s.set_neighbours([fleet.replicas[i], solos[i]])
 
+        from delta_crdt_ex_tpu.utils import jitcache
+
         dts: dict[str, list[float]] = {"fleet": [], "solo": []}
+        pre_jit: dict = {}
         for rnd in range(rounds + 1):  # round 0 is jit/compile warmup
+            if rnd == rounds:
+                # entering the LAST measured round: the steady state's
+                # shape buckets must all be warm
+                pre_jit = jitcache.compile_counts()
             base = 1_000_003 * rnd
             for i, s in enumerate(senders):
                 for j in range(keys_per_round):
@@ -1118,6 +1187,15 @@ def bench_fleet():
                     np.asarray(getattr(rs.state, c)),
                 ), f"fleet/solo state diverged at size {n}, member {i}: {c}"
 
+        # ISSUE 12 gate: the batched AND solo merge roots compiled
+        # nothing during the last measured round — zero steady-state
+        # compiles per shape bucket at this fleet size
+        jit_counts = _jit_steady_gate(
+            f"fleet size {n}",
+            ("fleet_merge_rows", "merge_rows", "row_apply"),
+            pre_jit, jitcache.compile_counts(),
+        )
+
         rate = lambda ds: n / statistics.median(ds)
         f_rate, s_rate = rate(dts["fleet"]), rate(dts["solo"])
         st = fleet.stats()
@@ -1135,6 +1213,8 @@ def bench_fleet():
             "ragged_fill_ratio": st["ragged_fill_ratio"],
             "fallbacks": st["fallbacks"],
             "parity": "bit_for_bit_state_checked",
+            "jit_compiles": jit_counts,
+            "jit_steady_state": "zero_compiles_in_last_round",
         }
         log(
             f"fleet {n}: {f_rate:.1f} vs solo {s_rate:.1f} merges/sec "
@@ -1145,6 +1225,8 @@ def bench_fleet():
 
     results = {str(n): run_size(n) for n in sizes}
     gate = str(16 if SMOKE else 256)
+    # the compile counter must also be visible on the export surface
+    _jit_metrics_probe(("fleet_merge_rows",))
 
     # ---- egress leg (ISSUE 10): batched sync ticks vs N sync_to_all ----
 

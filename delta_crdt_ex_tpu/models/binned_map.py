@@ -23,20 +23,23 @@ import numpy as np
 from delta_crdt_ex_tpu.models.binned import BinnedStore, pow2_tier as _pow2, pow4_tier as _pow4
 from delta_crdt_ex_tpu.ops import binned as binned_ops
 from delta_crdt_ex_tpu.ops.apply import OP_ADD, OP_CLEAR, OP_PAD, OP_REMOVE
+from delta_crdt_ex_tpu.utils.jitcache import named_jit
 
-jit_row_apply = jax.jit(binned_ops.row_apply)
-jit_clear_all = jax.jit(binned_ops.clear_all)
-jit_merge_slice = jax.jit(
+# named_jit = jax.jit + compile-cache audit registration under the
+# kernel's own name (``crdt_jit_compiles_total{name=...}``)
+jit_row_apply = named_jit(binned_ops.row_apply)
+jit_clear_all = named_jit(binned_ops.clear_all)
+jit_merge_slice = named_jit(
     binned_ops.merge_slice, static_argnames=("kill_budget", "max_inserts")
 )
-jit_merge_rows = jax.jit(binned_ops.merge_rows)
-jit_extract_rows = jax.jit(binned_ops.extract_rows)
-jit_extract_own_delta = jax.jit(binned_ops.extract_own_delta)
-jit_winners_for_keys = jax.jit(binned_ops.winners_for_keys)
-jit_winner_rows = jax.jit(binned_ops.winner_rows)
-jit_winner_all = jax.jit(binned_ops.winner_all)
-jit_compact_rows = jax.jit(binned_ops.compact_rows)
-jit_tree_from_leaves = jax.jit(binned_ops.tree_from_leaves)
+jit_merge_rows = named_jit(binned_ops.merge_rows)
+jit_extract_rows = named_jit(binned_ops.extract_rows)
+jit_extract_own_delta = named_jit(binned_ops.extract_own_delta)
+jit_winners_for_keys = named_jit(binned_ops.winners_for_keys)
+jit_winner_rows = named_jit(binned_ops.winner_rows)
+jit_winner_all = named_jit(binned_ops.winner_all)
+jit_compact_rows = named_jit(binned_ops.compact_rows)
+jit_tree_from_leaves = named_jit(binned_ops.tree_from_leaves)
 
 
 class GroupedBatch:

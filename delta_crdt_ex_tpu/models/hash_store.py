@@ -52,6 +52,7 @@ store.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from functools import partial
 
 import jax
@@ -188,16 +189,34 @@ def _ops():
 class _Jit:
     """Lazily-jitted kernel table (import cycle + first-use compile)."""
 
+    _build_lock = threading.Lock()
+
     def __getattr__(self, name):
-        ops = _ops()
-        static = {
-            "extract_rows_packed": ("lanes",),
-            "extract_own_delta_packed": ("lanes",),
-            "winner_rows_packed": ("lanes",),
-            "rehash": ("table_size", "probe_window"),
-        }.get(name, ())
-        fn = jax.jit(getattr(ops, name), static_argnames=static)
-        setattr(self, name, fn)
+        from delta_crdt_ex_tpu.utils.jitcache import named_jit
+
+        # build + cache + audit-register under one lock: two threads
+        # first-touching the same kernel concurrently must not register
+        # one jit object while dispatch caches the other — the audit's
+        # compile counts would silently read 0 for that root forever
+        with _Jit._build_lock:
+            fn = self.__dict__.get(name)
+            if fn is None:
+                ops = _ops()
+                static = {
+                    "extract_rows_packed": ("lanes",),
+                    "extract_own_delta_packed": ("lanes",),
+                    "winner_rows_packed": ("lanes",),
+                    "rehash": ("table_size", "probe_window"),
+                }.get(name, ())
+                # named_jit: compile-cache audit registration, prefixed
+                # so the hash kernels never collide with the binned
+                # roots' names
+                fn = named_jit(
+                    getattr(ops, name),
+                    name=f"hash_{name}",
+                    static_argnames=static,
+                )
+                setattr(self, name, fn)
         return fn
 
 

@@ -568,6 +568,16 @@ class MetricsBridge:
             "crdt_fleet_egress_seconds",
             "Batched egress tick wall time", ("fleet",),
         )
+        # monotone by construction (a tracing cache only grows), hence
+        # the _total name despite the set-to-absolute gauge primitive:
+        # the jitcache audit reports absolute per-root compile counts,
+        # not deltas, so a bridge attaching mid-process still exports
+        # the true totals
+        self.jit_compiles = g(
+            "crdt_jit_compiles_total",
+            "XLA compiles per named jit entry root (tracing-cache size)",
+            ("name",),
+        )
         # batchable handlers for the two per-message hot families: the
         # grouped ingest path emits them via telemetry.execute_many, and
         # the batch form folds the whole group under ONE registry-lock
@@ -598,6 +608,7 @@ class MetricsBridge:
             (telemetry.CATCHUP_DONE, self._on_catchup_done),
             (telemetry.FLEET_DISPATCH, self._on_fleet_dispatch),
             (telemetry.FLEET_EGRESS, self._on_fleet_egress),
+            (telemetry.JIT_COMPILE, self._on_jit_compile),
         ]
 
     def attach(self) -> "MetricsBridge":
@@ -747,6 +758,11 @@ class MetricsBridge:
             self.fleet_egress_frames._inc_held(lb, g("frames", 0))
             self.fleet_egress_frame_members._inc_held(lb, g("frame_members", 0))
             self.fleet_egress_seconds._observe_held(lb, g("duration_s", 0.0))
+
+    def _on_jit_compile(self, _event, meas, meta) -> None:
+        lb = (self._s(meta.get("name")),)
+        with self._lock:
+            self.jit_compiles._set_held(lb, meas.get("compiles", 0))
 
 
 # ----------------------------------------------------------------------
@@ -1030,6 +1046,22 @@ class Observability:
             "crdt_fleet_egress_bucket_occupancy",
             "Mean members per batched egress extraction bucket", ("fleet",),
         )
+        # compile-cache audit (ISSUE 12): a scrape-time collector runs
+        # the jitcache audit, which re-publishes EVERY named jit entry
+        # root's absolute tracing-cache size through JIT_COMPILE
+        # telemetry on each scrape (idempotent gauge sets — so any
+        # plane, attached at any point, exports the true totals); the
+        # bridge row above folds those into
+        # crdt_jit_compiles_total{name=...}. Collector-fed so an idle
+        # process pays nothing between scrapes.
+        from delta_crdt_ex_tpu.utils import jitcache as _jitcache
+
+        def _collect_jit_compiles() -> None:
+            _jitcache.audit()
+
+        self._jit_collector = _collect_jit_compiles
+        self.registry.register_collector(_collect_jit_compiles)
+        self.add_varz_source("jitcache", _jitcache.varz)
         self._c_drained = self.registry.counter(
             "crdt_drained_messages_total",
             "Messages drained by the replica event loop", ("name",),
@@ -1206,6 +1238,10 @@ class Observability:
         telemetry handler table is process-global, so a discarded plane
         must not keep consuming events)."""
         self.bridge.detach()
+        # same contract as unregister_replica/_fleet: a closed plane
+        # must not keep running the compile-cache audit at scrape time
+        self.registry.unregister_collector(self._jit_collector)
+        self.remove_source("jitcache")
         with self._lock:
             server, self._server = self._server, None
         if server is not None:

@@ -34,6 +34,7 @@ from delta_crdt_ex_tpu.models.binned import BinnedStore
 from delta_crdt_ex_tpu.models.hash_store import HashStore
 from delta_crdt_ex_tpu.ops import binned as binned_ops
 from delta_crdt_ex_tpu.ops import hash_map as hash_ops
+from delta_crdt_ex_tpu.utils.jitcache import named_jit
 
 # ---------------------------------------------------------------------------
 # single-replica transitions (the replica loop's device calls, re-exported
@@ -119,14 +120,16 @@ def fleet_winner_all(states: BinnedStore) -> binned_ops.RowWinners:
     return jax.vmap(binned_ops.winner_all)(states)
 
 
-jit_fleet_merge_rows = jax.jit(fleet_merge_rows)
-jit_fleet_row_apply = jax.jit(fleet_row_apply)
-jit_fleet_extract_rows = jax.jit(fleet_extract_rows)
-jit_fleet_interval_slices = jax.jit(fleet_interval_slices)
-jit_fleet_tree_from_leaves = jax.jit(fleet_tree_from_leaves)
-jit_fleet_own_ctr_columns = jax.jit(fleet_own_ctr_columns)
-jit_fleet_compact_rows = jax.jit(fleet_compact_rows)
-jit_fleet_winner_all = jax.jit(fleet_winner_all)
+# named_jit = jax.jit + a compile-cache audit registration (the SHAPE
+# family's runtime cross-check: ``crdt_jit_compiles_total{name=...}``)
+jit_fleet_merge_rows = named_jit(fleet_merge_rows)
+jit_fleet_row_apply = named_jit(fleet_row_apply)
+jit_fleet_extract_rows = named_jit(fleet_extract_rows)
+jit_fleet_interval_slices = named_jit(fleet_interval_slices)
+jit_fleet_tree_from_leaves = named_jit(fleet_tree_from_leaves)
+jit_fleet_own_ctr_columns = named_jit(fleet_own_ctr_columns)
+jit_fleet_compact_rows = named_jit(fleet_compact_rows)
+jit_fleet_winner_all = named_jit(fleet_winner_all)
 
 
 # ---------------------------------------------------------------------------
@@ -192,15 +195,15 @@ def fleet_hash_interval_slices(
     )(states, rows, self_slots, gid_selfs, lo)
 
 
-jit_fleet_hash_merge_rows = jax.jit(fleet_hash_merge_rows)
-jit_fleet_hash_row_apply = jax.jit(fleet_hash_row_apply)
-jit_fleet_hash_winner_all = jax.jit(fleet_hash_winner_all)
-jit_fleet_hash_row_counts = jax.jit(fleet_hash_row_counts)
-jit_fleet_hash_own_delta_counts = jax.jit(fleet_hash_own_delta_counts)
-jit_fleet_hash_extract_rows = jax.jit(
+jit_fleet_hash_merge_rows = named_jit(fleet_hash_merge_rows)
+jit_fleet_hash_row_apply = named_jit(fleet_hash_row_apply)
+jit_fleet_hash_winner_all = named_jit(fleet_hash_winner_all)
+jit_fleet_hash_row_counts = named_jit(fleet_hash_row_counts)
+jit_fleet_hash_own_delta_counts = named_jit(fleet_hash_own_delta_counts)
+jit_fleet_hash_extract_rows = named_jit(
     fleet_hash_extract_rows, static_argnames=("lanes",)
 )
-jit_fleet_hash_interval_slices = jax.jit(
+jit_fleet_hash_interval_slices = named_jit(
     fleet_hash_interval_slices, static_argnames=("lanes",)
 )
 
@@ -220,7 +223,7 @@ def stack_pytrees(*trees):
     return jax.tree.map(lambda *xs: jax.numpy.stack(xs), *trees)
 
 
-jit_stack_pytrees = jax.jit(stack_pytrees)
+jit_stack_pytrees = named_jit(stack_pytrees)
 
 
 def stack_states(states: list) -> BinnedStore:

@@ -1,0 +1,138 @@
+"""Compile-cache audit for named jit entry roots (ISSUE 12).
+
+The batched-fleet design only pays off while every hot dispatch hits a
+warm XLA cache: the shape-bucketing/padding discipline (pow2/pow4 lane
+tiers, geometry keys) exists precisely so the distinct-compile count
+per entry root stays bounded by the distinct bucket-geometry count.
+crdtlint's SHAPE family enforces that discipline *statically*; this
+module is the *runtime* half of the cross-check — every hot jit entry
+root is created through :func:`named_jit`, which registers the jitted
+callable in a process-wide table, and :func:`compile_counts` reads each
+root's tracing-cache size (one cache entry per distinct operand
+geometry / static-arg combination, i.e. per XLA compile).
+
+The audit surfaces three ways:
+
+- ``crdt_jit_compiles_total{name=...}`` through the metrics bridge
+  (:func:`audit` emits ``JIT_COMPILE`` telemetry for roots whose count
+  moved; the observability plane runs the audit as a scrape-time
+  collector) and the ``/varz`` ``jitcache`` source;
+- ``bench.py --ingest`` / ``--fleet`` gate IN-RUN on **zero
+  steady-state compiles after warmup** per shape bucket;
+- ``tests/test_jitcache.py`` drives a fleet through mixed-occupancy
+  tick cycles and asserts the compile count is bounded by the distinct
+  bucket-geometry count.
+
+``named_jit`` returns the ``jax.jit`` product unchanged (zero call
+overhead — the registry holds a reference, it does not wrap). crdtlint
+recognises ``named_jit(fn, ...)`` as a SYNC001 jit entry exactly like
+``jax.jit(fn)``.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_lock = threading.Lock()
+#: root name -> jitted callable (insertion = module import order)
+_roots: dict[str, object] = {}
+
+
+def named_jit(fn, *, name: str | None = None, **jit_kw):
+    """``jax.jit`` + registration under ``name`` (default: the
+    function's ``__name__``) for the compile-cache audit. Keyword
+    arguments pass through to ``jax.jit`` unchanged."""
+    jitted = jax.jit(fn, **jit_kw)
+    register(name or getattr(fn, "__name__", repr(fn)), jitted)
+    return jitted
+
+
+def register(name: str, jitted) -> None:
+    """Register an already-jitted callable (lazily built kernel tables
+    like ``models/hash_store._Jit`` register here on first use).
+
+    A name collision with a DIFFERENT callable raises: silently
+    evicting the earlier root would blind the compile-cache audit (and
+    the bench zero-steady-state gates) to every recompile of whichever
+    object keeps being dispatched. Re-registering the same object is
+    idempotent."""
+    with _lock:
+        prior = _roots.get(name)
+        if prior is not None and prior is not jitted:
+            raise ValueError(
+                f"jitcache: root name {name!r} already registered to a "
+                f"different jitted callable — pass an explicit unique "
+                f"name= to named_jit"
+            )
+        _roots[name] = jitted
+
+
+def _cache_size_of(jitted) -> int | None:
+    """Tracing-cache entry count of one jitted callable — one entry per
+    compiled executable (distinct shapes/dtypes/static args). ``None``
+    when the jax build does not expose the counter."""
+    probe = getattr(jitted, "_cache_size", None)
+    if probe is None:
+        return None
+    try:
+        return int(probe())
+    except Exception:
+        return None
+
+
+def supported() -> bool:
+    """Does this jax build expose per-function tracing-cache sizes?
+    (The bench gates assert this so an unsupported build cannot turn
+    the zero-steady-state-compiles gate vacuously green.)"""
+    return _cache_size_of(jax.jit(lambda: 0)) is not None
+
+
+def compile_counts() -> dict[str, int]:
+    """``{root name: compiles so far}`` for every registered root, in
+    sorted name order. Roots whose cache size cannot be read are
+    omitted (so a gate diffing two snapshots never sees phantom
+    deltas)."""
+    with _lock:
+        items = sorted(_roots.items())
+    out: dict[str, int] = {}
+    for name, jitted in items:
+        n = _cache_size_of(jitted)
+        if n is not None:
+            out[name] = n
+    return out
+
+
+def total_compiles() -> int:
+    return sum(compile_counts().values())
+
+
+def audit() -> dict[str, int]:
+    """Read every root's compile count and emit ``JIT_COMPILE``
+    telemetry carrying the ABSOLUTE per-root count — the metrics
+    bridge's subscription row folds those into
+    ``crdt_jit_compiles_total{name=...}`` with an idempotent gauge set,
+    so any number of planes (each with its own registry) can audit
+    independently and a bridge attaching mid-process still exports the
+    true totals. The observability plane runs this as a scrape-time
+    collector; the bench gates call it around their timed phases.
+    Returns the current counts."""
+    # deferred import: this module sits below the model layer in the
+    # import graph (models register their kernels here at import time),
+    # so a top-level runtime import would cycle through runtime/__init__
+    from delta_crdt_ex_tpu.runtime import telemetry
+
+    counts = compile_counts()
+    if not telemetry.has_handlers(telemetry.JIT_COMPILE):
+        return counts
+    for name, n in counts.items():
+        telemetry.execute(
+            telemetry.JIT_COMPILE, {"compiles": n}, {"name": name}
+        )
+    return counts
+
+
+def varz() -> dict:
+    """``/varz`` source: the audit's unified snapshot envelope."""
+    return {"kind": "jitcache", "stats": {"compiles": compile_counts()}}

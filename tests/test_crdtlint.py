@@ -2688,3 +2688,477 @@ def test_mutation_declared_unemitted_event_is_caught():
     msgs = [f.message for f in new if f.rule == "OBS001"]
     assert any("never emitted" in m for m in msgs)
     assert any("bridge" in m for m in msgs)
+
+
+# ----------------------------------------------------------------------
+# SHAPE001/SHAPE002 — recompile discipline (ISSUE 12)
+
+
+SHAPE_REPLICA_RAW = """
+    import numpy as np
+
+    def jit_merge(state, sl):
+        return state
+
+    class Replica:
+        def drain(self, msgs, state):
+            n = len(msgs)
+            rows = np.full(n, -1, np.int32)
+            return jit_merge(state, rows)
+"""
+
+SHAPE_REPLICA_TIERED = """
+    import numpy as np
+
+    def pow2_tier(n, floor=1):
+        return max(n, floor)
+
+    def jit_merge(state, sl):
+        return state
+
+    class Replica:
+        def drain(self, msgs, state):
+            n = pow2_tier(len(msgs))
+            rows = np.full(n, -1, np.int32)
+            return jit_merge(state, rows)
+"""
+
+
+def test_shape001_raw_len_operand_flagged(tmp_path):
+    pkg = make_pkg(tmp_path, {"runtime/replica.py": SHAPE_REPLICA_RAW})
+    found = [f for f in lint(pkg) if f.rule == "SHAPE001"]
+    assert len(found) == 1 and "jit_merge" in found[0].message
+
+
+def test_shape001_tiered_operand_clean(tmp_path):
+    pkg = make_pkg(tmp_path, {"runtime/replica.py": SHAPE_REPLICA_TIERED})
+    assert [f for f in lint(pkg) if f.rule.startswith("SHAPE")] == []
+
+
+def test_shape001_pad_fn_lanes_discipline(tmp_path):
+    """``stack_entry_slices`` lanes= must be tier-derived; a raw
+    ``len()`` (or omitting lanes entirely) is red."""
+    fleet = """
+        def pow2_tier(n, floor=1):
+            return max(n, floor)
+
+        def stack_entry_slices(slices, lanes=None):
+            return slices, 0
+
+        class Fleet:
+            def dispatch(self, members):
+                sl, _ = stack_entry_slices(
+                    [m.sl for m in members], lanes={lanes}
+                )
+                return sl
+    """
+    red_raw = make_pkg(
+        tmp_path / "raw",
+        {"runtime/fleet.py": fleet.format(lanes="len(members)")},
+    )
+    found = [f for f in lint(red_raw) if f.rule == "SHAPE001"]
+    assert len(found) == 1 and "raw data-dependent size" in found[0].message
+
+    green = make_pkg(
+        tmp_path / "tiered",
+        {"runtime/fleet.py": fleet.format(lanes="pow2_tier(len(members), floor=2)")},
+    )
+    assert [f for f in lint(green) if f.rule.startswith("SHAPE")] == []
+
+    omitted = fleet.replace(", lanes={lanes}", "").replace("\n                )", ")")
+    red_omit = make_pkg(tmp_path / "omit", {"runtime/fleet.py": omitted})
+    found = [f for f in lint(red_omit) if f.rule == "SHAPE001"]
+    assert len(found) == 1 and "without lanes=" in found[0].message
+
+
+def test_shape001_unpadded_stack_flagged(tmp_path):
+    """A list stacked by ``stack_pytrees`` must be tier-padded in
+    scope; the ``lst += [lst[0]] * (lanes - len(lst))`` idiom (with a
+    sanitised tier) is the green form."""
+    fleet = """
+        def pow2_tier(n, floor=1):
+            return max(n, floor)
+
+        def jit_stack_pytrees(*trees):
+            return trees
+
+        class Fleet:
+            def tick(self, items):
+                leaves = [e.leaf for e in items]
+{pad}
+                return jit_stack_pytrees(*leaves)
+    """
+    red = make_pkg(tmp_path / "red", {"runtime/fleet.py": fleet.format(pad="")})
+    found = [f for f in lint(red) if f.rule == "SHAPE001"]
+    assert len(found) == 1 and "never padded" in found[0].message
+
+    pad = (
+        "                lanes = pow2_tier(len(items), floor=2)\n"
+        "                leaves += [leaves[0]] * (lanes - len(items))"
+    )
+    green = make_pkg(tmp_path / "green", {"runtime/fleet.py": fleet.format(pad=pad)})
+    assert [f for f in lint(green) if f.rule.startswith("SHAPE")] == []
+
+
+def test_shape002_static_arg_vocabulary(tmp_path):
+    """Static args at jit call sites come from the closed geometry
+    vocabulary: tier calls, constants, geometry attributes, forwarded
+    params — a raw ``len()`` static is red."""
+    mod = """
+        import jax
+
+        def pow2_tier(n, floor=1):
+            return max(n, floor)
+
+        def extract(state, rows, lanes):
+            return state
+
+        jit_extract = jax.jit(extract, static_argnames=("lanes",))
+
+        def ship(state, rows, msgs):
+            return jit_extract(state, rows, lanes={lanes})
+    """
+    red = make_pkg(
+        tmp_path / "red", {"models/hash_store.py": mod.format(lanes="len(msgs)")}
+    )
+    found = [f for f in lint(red) if f.rule == "SHAPE002"]
+    assert len(found) == 1 and "lanes=" in found[0].message
+
+    for i, lanes in enumerate(
+        ("pow2_tier(len(msgs))", "32", "state.table_size * 2")
+    ):
+        green = make_pkg(
+            tmp_path / f"green{i}",
+            {"models/hash_store.py": mod.format(lanes=lanes)},
+        )
+        assert [f for f in lint(green) if f.rule.startswith("SHAPE")] == [], lanes
+
+
+def test_shape_allow_tag(tmp_path):
+    """The ``shape`` family tag suppresses with a stated why."""
+    annotated = SHAPE_REPLICA_RAW.replace(
+        "            return jit_merge(state, rows)",
+        "            # crdtlint: allow[shape] one-shot recovery path:\n"
+        "            # runs once per boot, recompiles are irrelevant\n"
+        "            return jit_merge(state, rows)",
+    )
+    pkg = make_pkg(tmp_path, {"runtime/replica.py": annotated})
+    new, _baselined, allowed = run_lint([pkg])
+    assert new == []
+    assert {f.rule for f in allowed} == {"SHAPE001"}
+
+
+# ----------------------------------------------------------------------
+# LEAK001 — buffer-pinning closure captures (ISSUE 12)
+
+
+#: ``{body}`` lines use ABSOLUTE indentation matching the template
+#: (drain statements at 12, nested closure bodies at 16, sibling
+#: methods at 8) — dedent strips the common 4-space prefix.
+LEAK_REPLICA = """
+    def jit_merge_rows(state, sl):
+        return state
+
+    class Replica:
+        def __init__(self):
+            self._defer = []
+            self._state = None
+
+        def drain(self, sl):
+            res = jit_merge_rows(self._state, sl)
+{body}
+"""
+
+
+def test_leak001_escaping_whole_result_flagged(tmp_path):
+    body = (
+        "            def emit():\n"
+        "                return res.n_inserted\n"
+        "            self._defer.append(emit)"
+    )
+    pkg = make_pkg(tmp_path, {"runtime/replica.py": LEAK_REPLICA.format(body=body)})
+    found = [f for f in lint(pkg) if f.rule == "LEAK001"]
+    assert len(found) == 1
+    assert "res" in found[0].message and "default-arg capture" in found[0].message
+
+
+def test_leak001_default_arg_narrowing_clean(tmp_path):
+    """The PR 9 fix idiom: default-arg capture of just the count leaves
+    is green — defaults evaluate at def time, res is never held."""
+    body = (
+        "            def emit(ins=res.n_inserted, kill=res.n_killed):\n"
+        "                return ins + kill\n"
+        "            self._defer.append(emit)"
+    )
+    pkg = make_pkg(tmp_path, {"runtime/replica.py": LEAK_REPLICA.format(body=body)})
+    assert [f for f in lint(pkg) if f.rule == "LEAK001"] == []
+
+
+def test_leak001_heavy_default_still_flagged(tmp_path):
+    """``r=res`` / ``s=res.state`` as a default re-widens the capture —
+    the default holds the whole pytree exactly like free capture."""
+    body = (
+        "            def emit(s=res.state):\n"
+        "                return s\n"
+        "            self._defer.append(emit)"
+    )
+    pkg = make_pkg(tmp_path, {"runtime/replica.py": LEAK_REPLICA.format(body=body)})
+    found = [f for f in lint(pkg) if f.rule == "LEAK001"]
+    assert len(found) == 1 and "res.state" in found[0].message
+
+
+def test_leak001_interprocedural_deferrer(tmp_path):
+    """A closure handed to a method that parks its parameter (the
+    ``_note_state_changed`` shape) escapes one call down — the
+    storing-parameter fix point must see through the indirection."""
+    body = (
+        "            def emit():\n"
+        "                return res\n"
+        "            self._note(emit)\n"
+        "\n"
+        "        def _note(self, count_fn):\n"
+        "            self._defer.append(count_fn)"
+    )
+    pkg = make_pkg(tmp_path, {"runtime/replica.py": LEAK_REPLICA.format(body=body)})
+    found = [f for f in lint(pkg) if f.rule == "LEAK001"]
+    assert len(found) == 1 and "_note" in found[0].message
+
+
+def test_leak001_self_state_capture_flagged(tmp_path):
+    body = (
+        "            def emit():\n"
+        "                return self._state\n"
+        "            self._callback = emit"
+    )
+    pkg = make_pkg(tmp_path, {"runtime/replica.py": LEAK_REPLICA.format(body=body)})
+    found = [f for f in lint(pkg) if f.rule == "LEAK001"]
+    assert len(found) == 1 and "self._state" in found[0].message
+
+
+def test_leak001_local_closure_clean(tmp_path):
+    """A closure that never escapes (called inline, handed to an
+    immediately-applied combinator) may capture anything."""
+    body = (
+        "            def pick(lane):\n"
+        "                return res\n"
+        "            return pick(0)"
+    )
+    pkg = make_pkg(tmp_path, {"runtime/replica.py": LEAK_REPLICA.format(body=body)})
+    assert [f for f in lint(pkg) if f.rule == "LEAK001"] == []
+
+
+def test_leak001_factory_result_escape(tmp_path):
+    """A closure factory's call result carries the inner closure's
+    captures into the sink (the fleet ``counts_for`` shape)."""
+    body = (
+        "            def make(lane):\n"
+        "                def fn():\n"
+        "                    return res\n"
+        "                return fn\n"
+        "            self._note(make(0))\n"
+        "\n"
+        "        def _note(self, count_fn):\n"
+        "            self._defer.append(count_fn)"
+    )
+    pkg = make_pkg(tmp_path, {"runtime/replica.py": LEAK_REPLICA.format(body=body)})
+    found = [f for f in lint(pkg) if f.rule == "LEAK001"]
+    assert len(found) == 1 and "make" in found[0].message
+
+
+def test_leak001_cold_module_clean(tmp_path):
+    """The rule is a hot-path (replica/fleet) contract — a storage
+    module parking closures is not its business."""
+    body = (
+        "        def emit():\n"
+        "            return res\n"
+        "        self._defer.append(emit)"
+    )
+    pkg = make_pkg(
+        tmp_path, {"runtime/storage.py": LEAK_REPLICA.format(body=body)}
+    )
+    assert [f for f in lint(pkg) if f.rule == "LEAK001"] == []
+
+
+# ----------------------------------------------------------------------
+# SPMD001 — shard_map readiness of transition-contract modules
+
+
+def test_spmd001_host_callback_flagged(tmp_path):
+    pkg = make_pkg(tmp_path, {"runtime/transition.py": """
+        import jax
+
+        def fleet_step(states):
+            jax.debug.print("x {s}", s=states)
+            return states
+    """})
+    found = [f for f in lint(pkg) if f.rule == "SPMD001"]
+    assert len(found) == 1 and "host callback" in found[0].message
+
+
+def test_spmd001_axis_branch_flagged(tmp_path):
+    pkg = make_pkg(tmp_path, {"runtime/transition.py": """
+        def fleet_step(states):
+            if states.key.shape[0] > 4:
+                return states
+            return states
+    """})
+    found = [f for f in lint(pkg) if f.rule == "SPMD001"]
+    assert len(found) == 1 and "shard" in found[0].message
+
+
+def test_spmd001_axis_free_reduction_flagged(tmp_path):
+    pkg = make_pkg(tmp_path, {"runtime/transition.py": """
+        import jax.numpy as jnp
+
+        def fleet_total(states):
+            return jnp.sum(states)
+    """})
+    found = [f for f in lint(pkg) if f.rule == "SPMD001"]
+    assert len(found) == 1 and "axis-free reduction" in found[0].message
+
+
+def test_spmd001_vmapped_and_axised_forms_clean(tmp_path):
+    """Reductions inside vmapped inner functions are per-lane; explicit
+    axis= names the folded axes — both survive the mesh lift."""
+    pkg = make_pkg(tmp_path, {"runtime/transition.py": """
+        import jax
+        import jax.numpy as jnp
+
+        def fleet_total(states):
+            per_lane = jax.vmap(lambda s: jnp.sum(s))(states)
+            return jnp.sum(states, axis=1)
+    """})
+    assert [f for f in lint(pkg) if f.rule == "SPMD001"] == []
+
+
+def test_spmd001_cold_module_clean(tmp_path):
+    """Host callbacks in the I/O shell are the shell's business."""
+    pkg = make_pkg(tmp_path, {"runtime/replica.py": """
+        import jax
+
+        def drive(states):
+            jax.debug.print("x {s}", s=states)
+            return states
+    """})
+    assert [f for f in lint(pkg) if f.rule == "SPMD001"] == []
+
+
+# ----------------------------------------------------------------------
+# ISSUE 12 acceptance: the new families catch real-tree regressions
+# (engine overlay, working tree untouched)
+
+
+def test_mutation_fleet_pad_deleted_is_caught():
+    """Deleting the pow2 pad at the REAL fleet bucket stack site turns
+    the gate red (SHAPE001): exact member counts mint one executable
+    per occupancy."""
+    rel = f"{PKG}/runtime/fleet.py"
+    old = (
+        "        lanes = pow2_tier(n, floor=2)\n"
+        "        sl, real_rows = stack_entry_slices"
+    )
+    assert old in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(
+            old, "        lanes = n\n        sl, real_rows = stack_entry_slices"
+        ),
+    )
+    assert any(
+        f.rule == "SHAPE001" and "stack_entry_slices" in f.message for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_egress_tree_pad_deleted_is_caught():
+    """Deleting the egress tree-group pad (PR 10's review fix) is also
+    red — the batched periodic path would recompile per due-set size."""
+    rel = f"{PKG}/runtime/fleet.py"
+    old = (
+        "            leaves = [e.state.leaf for e in items]\n"
+        "            leaves += [leaves[0]] * (lanes - len(items))"
+    )
+    assert old in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(old, "            leaves = [e.state.leaf for e in items]"),
+    )
+    assert any(
+        f.rule == "SHAPE001" and "jit_stack_pytrees" in f.message for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_rewidened_deferred_closure_is_caught():
+    """ISSUE 12 acceptance: re-widening the REAL grouped-commit count
+    lambda to capture ``res`` (the PR 9 bug, verbatim) turns the gate
+    red (LEAK001) — that bug can never return silently."""
+    rel = f"{PKG}/runtime/replica.py"
+    old = "            lambda ins=res.n_ins_row, kill=res.n_kill_row: (ins, kill),"
+    assert old in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(old, "            lambda: (res.n_ins_row, res.n_kill_row),"),
+    )
+    assert any(
+        f.rule == "LEAK001" and "res" in f.message for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_rewidened_fleet_counts_factory_is_caught():
+    """Same class at the fleet commit seam: the ``counts_for`` factory
+    re-widened to read ``res`` inside the parked inner fn is red."""
+    rel = f"{PKG}/runtime/fleet.py"
+    old = (
+        "        def counts_for(lane, ins_rows=res.n_ins_row, kill_rows=res.n_kill_row):\n"
+        "            def fn():\n"
+        "                if not counts_cell:\n"
+        "                    counts_cell.append(jax.device_get((ins_rows, kill_rows)))"
+    )
+    assert old in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(old, (
+            "        def counts_for(lane):\n"
+            "            def fn():\n"
+            "                if not counts_cell:\n"
+            "                    counts_cell.append(jax.device_get((res.n_ins_row, res.n_kill_row)))"
+        )),
+    )
+    assert any(
+        f.rule == "LEAK001" and "counts_for" in f.message for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_host_callback_in_transition_is_caught():
+    """ISSUE 12 acceptance: a host callback injected into the REAL
+    transition module turns the gate red (SPMD001) before the
+    mesh-sharding PR would trip over it."""
+    rel = f"{PKG}/runtime/transition.py"
+    inject = (
+        "\n\ndef fleet_debug_probe(states):\n"
+        '    jax.debug.print("probe {x}", x=states)\n'
+        "    return states\n"
+    )
+    new = _overlay_lint(rel, lambda s: s + inject)
+    assert any(
+        f.rule == "SPMD001" and "host callback" in f.message for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_adhoc_static_lanes_is_caught():
+    """A novel ad-hoc static arg at the REAL hash dense-extraction site
+    is red (SHAPE002) — static values outside the geometry vocabulary
+    mint one executable per value."""
+    rel = f"{PKG}/models/hash_store.py"
+    old = "    return jit.extract_rows_packed(state, rows, lanes=_dense_lanes(counts))"
+    assert old in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(
+            old,
+            "    return jit.extract_rows_packed("
+            "state, rows, lanes=int(np.asarray(counts).max()) + 1)",
+        ),
+    )
+    assert any(
+        f.rule == "SHAPE002" and "lanes=" in f.message for f in new
+    ), "\n".join(f.render() for f in new)

@@ -1,7 +1,8 @@
 """The crdtlint tier-1 gate.
 
 One test runs the FULL rule suite (all families: LOCK, RACE, SYNC,
-PURE, DONATE, WIRE, WAL + the SUPPRESS hygiene pass) over the real package
+PURE, DONATE, WIRE, WAL, OBS, SHAPE, LEAK, SPMD + the SUPPRESS
+hygiene pass) over the real package
 through the engine and fails on any non-baselined finding — this is the
 regression gate CI leans on, so it renders findings verbatim on
 failure. The rest pin the gate's own wiring: the checked-in protocol
@@ -49,17 +50,19 @@ def test_gate_covers_every_catalogued_family():
     for family in ("LOCK001", "LOCK002", "LOCK003", "RACE001", "RACE002",
                    "RACE003", "RACE004", "RACE005", "SYNC001", "PURE001",
                    "DONATE001", "WIRE001", "WIRE005", "WAL001", "WAL002",
-                   "OBS001", "OBS002", "SUPPRESS001", "SUPPRESS002"):
+                   "OBS001", "OBS002", "SHAPE001", "SHAPE002", "LEAK001",
+                   "SPMD001", "SUPPRESS001", "SUPPRESS002"):
         assert family in catalogued
     # every registered checker's module exports at least one catalogued
     # rule id (wiring smoke, not a bijection)
-    assert len(ALL_RULES) >= 9
+    assert len(ALL_RULES) >= 12
 
 
 def test_full_suite_wall_clock_budget():
-    """The seven-family suite must stay comfortably inside the tier-1
+    """The twelve-family suite must stay comfortably inside the tier-1
     timeout: one full engine run over the real tree in under 60 s (it
-    takes ~2 s today — the budget is headroom, not a target)."""
+    takes ~9 s serial today — ``--jobs`` exists for CI that wants it
+    faster; the budget is headroom, not a target)."""
     import time
 
     t0 = time.perf_counter()
@@ -70,10 +73,30 @@ def test_full_suite_wall_clock_budget():
 def test_jobs_parallel_matches_serial():
     """--jobs N must be a pure wall-clock lever: findings, their order,
     and the allow/baseline partition are byte-identical to a serial
-    run (per-rule sharding, merged in registration order)."""
+    run (per-rule sharding, merged in registration order). Covers the
+    ISSUE 12 families too: SHAPE/LEAK/SPMD are whole-project analyses
+    (storing-parameter fix point, project-wide static-wrapper
+    discovery), so a per-file shard would lose their cross-file edges
+    — the per-rule sharding must keep them byte-identical."""
     serial = run_lint([REPO_ROOT / PKG])
     parallel = run_lint([REPO_ROOT / PKG], jobs=2)
     assert serial == parallel
+
+
+def test_jobs_parallel_matches_serial_on_red_tree():
+    """Same parity on a tree where the new families actually FIRE (the
+    green real tree can't distinguish ordering): a SHAPE001 mutation
+    overlay must produce identical findings serial and parallel."""
+    rel = f"{PKG}/runtime/fleet.py"
+    src = (REPO_ROOT / rel).read_text()
+    overlay = {rel: src.replace(
+        "        lanes = pow2_tier(n, floor=2)\n        sl, real_rows",
+        "        lanes = n\n        sl, real_rows",
+    )}
+    serial = run_lint([REPO_ROOT / PKG], overlay=overlay)
+    parallel = run_lint([REPO_ROOT / PKG], overlay=overlay, jobs=3)
+    assert serial == parallel
+    assert any(f.rule == "SHAPE001" for f in serial[0])
 
 
 def test_stats_reports_per_rule_timing():
@@ -135,7 +158,8 @@ def test_cli_gate_green_and_github_format(tmp_path):
 def test_cli_list_rules_names_all_families():
     out = _cli("--list-rules").stdout
     for rule in ("LOCK002", "LOCK003", "RACE001", "RACE005", "WIRE001",
-                 "WIRE004", "WIRE005", "WAL001", "WAL002", "SUPPRESS001"):
+                 "WIRE004", "WIRE005", "WAL001", "WAL002", "SHAPE001",
+                 "SHAPE002", "LEAK001", "SPMD001", "SUPPRESS001"):
         assert rule in out
 
 

@@ -27,7 +27,17 @@ import jax
 jax.distributed.initialize(coordinator_address=coord, num_processes=nproc, process_id=pid)
 import numpy as np
 from jax.experimental import multihost_utils
-assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+# report the detected device/mesh shape FIRST: when the probe fails,
+# the skip reason can then say what the environment actually offered
+# (chip-window logs otherwise show a bare skip with no why)
+shape = (
+    f"platform={jax.default_backend()}"
+    f" global_devices={len(jax.devices())}"
+    f" local_devices={len(jax.local_devices())}"
+    f" processes={jax.process_count()}"
+)
+print("PROBE_SHAPE", shape, flush=True)
+assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4, shape
 # a global computation spanning both processes' devices — the exact
 # operation class the gossip drive's ppermutes need
 out = multihost_utils.process_allgather(np.int32(pid), tiled=False)
@@ -196,11 +206,21 @@ def _global_cpu_mesh_capability(tmp_path) -> "tuple[bool, str]":
         except subprocess.TimeoutExpired:
             _PROBE_RESULT = (False, "capability probe timed out")
             return _PROBE_RESULT
+        # detected device/mesh shape, whichever process reported one —
+        # recorded into the skip reason so chip-window logs show WHAT
+        # the environment offered, not just that the legs skipped
+        shapes = {
+            line.split("PROBE_SHAPE ", 1)[1]
+            for _rc, out, _err in outs
+            for line in out.splitlines()
+            if line.startswith("PROBE_SHAPE ")
+        }
+        shape = "; ".join(sorted(shapes)) if shapes else "no device shape reported"
         bad = [(rc, err) for rc, out, err in outs if rc != 0 or "PROBE_OK" not in out]
         if bad:
             rc, err = bad[0]
             tail = err.strip().splitlines()[-1] if err.strip() else f"exit {rc}"
-            _PROBE_RESULT = (False, tail[-300:])
+            _PROBE_RESULT = (False, f"{tail[-300:]} [detected: {shape}]")
         else:
             _PROBE_RESULT = (True, "")
     return _PROBE_RESULT

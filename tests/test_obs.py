@@ -175,3 +175,41 @@ def test_flight_recorder_dumped_on_crash(tmp_path, transport, caplog):
         assert any("wal_compact" in m for m in caplog.messages)
     finally:
         plane.close()
+
+
+def test_jit_compile_counter_end_to_end(served):
+    """ISSUE 12: the compile-cache audit rides the plane end-to-end —
+    the scrape-time collector runs ``jitcache.audit()``, the JIT_COMPILE
+    bridge row folds it into ``crdt_jit_compiles_total{name=...}``, and
+    ``/varz`` carries the per-root snapshot. The served replicas above
+    merged through the named entry roots, so the counter is live."""
+    from delta_crdt_ex_tpu.utils import jitcache
+
+    plane, server, _a, _b = served
+    status, _ctype, body = _get(server.url + "/metrics")
+    assert status == 200
+    assert "# TYPE crdt_jit_compiles_total gauge" in body
+    m = re.search(r'crdt_jit_compiles_total\{name="merge_rows"\} (\d+)', body)
+    assert m and int(m.group(1)) >= 1, body[:2000]
+    # the exported value is the audit's absolute per-root count
+    assert int(m.group(1)) == jitcache.compile_counts()["merge_rows"]
+
+    status, _ctype, vbody = _get(server.url + "/varz")
+    doc = json.loads(vbody)
+    stanza = doc["sources"]["jitcache"]
+    assert stanza["kind"] == "jitcache"
+    assert stanza["stats"]["compiles"]["merge_rows"] >= 1
+
+
+def test_jit_compile_collector_unregistered_on_close(transport):
+    """A closed plane must stop running the compile-cache audit and
+    drop its varz source — the unregister-cleanup contract every other
+    collector already honours."""
+    plane = Observability()
+    try:
+        assert "jitcache" in plane.varz()["sources"]
+        ncoll = len(plane.registry._collectors)
+    finally:
+        plane.close()
+    assert "jitcache" not in plane.varz()["sources"]
+    assert len(plane.registry._collectors) == ncoll - 1

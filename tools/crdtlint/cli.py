@@ -79,6 +79,19 @@ RULE_CATALOG = [
     ("OBS002", "unguarded telemetry.execute in a hot-path module "
                "(replica/fleet/transports) — disabled telemetry still pays "
                "dict building there; guard with telemetry.has_handlers"),
+    ("SHAPE001", "jit dispatch operand shaped by a raw data-dependent Python "
+                 "size (len()-derived, never routed through a pow2/pow4 tier "
+                 "or pad function) — unbounded recompiles"),
+    ("SHAPE002", "static (hashable) argument at a jit call site outside the "
+                 "closed geometry-key vocabulary — one fresh executable per "
+                 "novel value"),
+    ("LEAK001", "closure capturing a kernel-result pytree / Store / "
+                "self.*state* escapes its defining scope (deferral list, "
+                "attribute, telemetry) — pins superseded device buffers; "
+                "narrow via default-arg capture of count/scalar leaves"),
+    ("SPMD001", "shard_map-unsafe construct in a transition-contract module: "
+                "host callback, Python branch on a replica-axis size, or "
+                "axis-free reduction over the replica axis"),
     ("SUPPRESS001", "stale allow[...] comment matching no finding (hygiene; "
                     "not itself suppressible)"),
     ("SUPPRESS002", "stale baseline entry matching no finding (hygiene; "

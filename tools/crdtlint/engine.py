@@ -30,6 +30,9 @@ FAMILY_TAGS = {
     "wire": "WIRE",
     "wal": "WAL",
     "obs": "OBS",
+    "shape": "SHAPE",
+    "leak": "LEAK",
+    "spmd": "SPMD",
 }
 
 #: hygiene meta-rules (stale suppressions). They report on the

@@ -74,6 +74,22 @@ def iter_function_defs(tree: ast.AST):
     yield from walk(tree, ())
 
 
+def outer_function_defs(tree: ast.AST):
+    """(qualname_parts, fn) for functions NOT nested inside another
+    function — rule families that analyse nested defs *within* their
+    enclosing scope (closure captures share the parent's locals) use
+    this to visit each closure exactly once."""
+    def walk(node: ast.AST, stack: tuple[str, ...]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield stack + (child.name,), child
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, stack + (child.name,))
+            else:
+                yield from walk(child, stack)
+    yield from walk(tree, ())
+
+
 from tools.crdtlint.rules.locks import check_lock_discipline
 from tools.crdtlint.rules.lockorder import check_lock_order
 from tools.crdtlint.rules.races import check_races
@@ -83,6 +99,9 @@ from tools.crdtlint.rules.donation import check_donation
 from tools.crdtlint.rules.wire import check_wire
 from tools.crdtlint.rules.walkinds import check_wal_kinds
 from tools.crdtlint.rules.obs import check_obs
+from tools.crdtlint.rules.shapes import check_shapes
+from tools.crdtlint.rules.leaks import check_leaks
+from tools.crdtlint.rules.spmd import check_spmd
 
 ALL_RULES = [
     check_lock_discipline,
@@ -94,4 +113,7 @@ ALL_RULES = [
     check_wire,
     check_wal_kinds,
     check_obs,
+    check_shapes,
+    check_leaks,
+    check_spmd,
 ]

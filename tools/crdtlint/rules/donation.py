@@ -73,9 +73,12 @@ def _collect_wrappers(project: Project) -> dict[tuple[str, str], _JitWrapper]:
     out: dict[tuple[str, str], _JitWrapper] = {}
 
     def jit_call(node: ast.AST) -> ast.Call | None:
+        # named_jit (utils/jitcache.py) is jax.jit + audit registration
+        # — donation kwargs pass through it unchanged
         if (
             isinstance(node, ast.Call)
-            and (_dotted(node.func) or "").rsplit(".", 1)[-1] == "jit"
+            and (_dotted(node.func) or "").rsplit(".", 1)[-1]
+            in ("jit", "named_jit")
         ):
             return node
         return None

@@ -42,7 +42,11 @@ RULE_JIT = "SYNC001"
 RULE_OP = "SYNC002"
 
 _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
-_JIT_NAMES = {"jit"}
+#: ``named_jit`` is the jitcache wrapper (``utils/jitcache.py``):
+#: ``named_jit(f, ...)`` IS ``jax.jit(f, ...)`` plus a compile-cache
+#: audit registration, so it is an entry root for exactly the same
+#: reasons
+_JIT_NAMES = {"jit", "named_jit"}
 _ENTRY_WRAPPERS = {"shard_map", "pallas_call", "pmap"}
 _OP_MODULE_MARKERS = (".ops.", ".parallel.")
 #: modules whose every function is a jit entry root by contract (the

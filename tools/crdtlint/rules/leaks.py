@@ -1,0 +1,497 @@
+"""LEAK001 — buffer-pinning closure captures (the PR 9 bug, as a class).
+
+The worst perf bug found so far: the deferred ``SYNC_DONE`` accounting
+closures captured the whole ``MergeRowsResult`` — including
+``res.state`` — and were parked in the drain's deferral window. Every
+superseded store generation stayed referenced for the window, defeating
+XLA's input-buffer reuse on each subsequent merge (a full-store copy
+per dispatch, ~40% of enabled ingest wall at coalesce depth 64). The
+fix idiom: **default-arg capture of just the count/scalar leaves**
+(``lambda ins=res.n_ins_row, kill=res.n_kill_row: …``) — defaults
+evaluate at definition time, so the closure holds only the small
+arrays, never ``res``.
+
+LEAK001 generalises that to the class: in a hot-path module (replica /
+fleet), a nested def or lambda that
+
+- **captures something heavy** — a kernel-result pytree (a local bound
+  from a ``jit_*`` / merge-kernel call), a ``Store``-typed or
+  state/store-named value, or ``self`` with the body reading a
+  ``self.*state*``/``self.*store*`` attribute — as a free variable OR
+  as a default argument whose value is the bare heavy name (or its
+  ``.state``/``.store`` leaf: ``s=res.state`` pins exactly what free
+  capture would), and
+- **escapes its defining scope** — appended/put to a container, stored
+  to an attribute or subscript, or passed to a project function/method
+  that stores its parameter (``_note_state_changed`` → the deferral
+  list, ``telemetry.attach``, collector registration), discovered by a
+  fix-point over parameter flows so a deferral one call down still
+  counts,
+
+is red: the capture pins superseded device buffers across the deferral
+window. Narrow it to the count/scalar leaves via default-arg capture
+(recognised as green), or keep the closure local (a closure handed to
+an immediately-applied combinator like ``jax.tree.map`` never parks).
+
+Closure factories are folded through: a nested def returning another
+nested def contributes the inner function's captures to any escape of
+the factory's *call result* (``counts_for(lane)`` handed to
+``fleet_commit``).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.crdtlint.engine import Finding, ModuleInfo, Project, _dotted
+from tools.crdtlint.rules import (
+    MUTATOR_METHODS,
+    iter_function_defs,
+    outer_function_defs,
+)
+
+RULE = "LEAK001"
+
+#: modules whose nested defs are checked (the drain/tick hot paths)
+_HOT_LEAVES = {"replica", "fleet"}
+
+#: call leaves returning kernel-result pytrees / new store generations
+_KERNEL_LEAVES = {
+    "merge_rows", "merge_slice", "row_apply", "clear_all", "compact_rows",
+    "merge_rows_into", "merge_group_into", "merge_into", "tier_retry_merge",
+    "fleet_merge_rows", "fleet_row_apply", "fleet_compact_rows",
+    "grow", "grow_table", "rehash", "stack_states", "stack_pytrees",
+}
+#: attribute-name substrings that make a value "heavy" (a store pytree
+#: or a stacked state)
+_HEAVY_ATTR_MARKERS = ("state", "store")
+#: parameter names that carry store pytrees by convention in this tree
+_HEAVY_PARAM_NAMES = {"state", "states", "store", "stacked", "stacked_in", "res"}
+#: callees OUTSIDE the project that store their argument (handler /
+#: collector registration — the "handed to telemetry/metrics" sinks)
+_EXTERNAL_STORING_LEAVES = {
+    "attach", "register_collector", "add_varz_source", "add_health_check",
+    "append", "appendleft", "add", "put", "put_nowait", "setdefault",
+}
+
+
+def _attr_is_heavy(name: str) -> bool:
+    return any(m in name for m in _HEAVY_ATTR_MARKERS)
+
+
+def _call_leaf(node: ast.Call) -> str:
+    return (_dotted(node.func) or "").rsplit(".", 1)[-1] or (
+        node.func.attr if isinstance(node.func, ast.Attribute) else ""
+    )
+
+
+# ----------------------------------------------------------------------
+# storing-parameter fix point: which params does each function park?
+
+
+def _assigned_names(target: ast.AST) -> list[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: list[str] = []
+        for e in target.elts:
+            out.extend(_assigned_names(e))
+        return out
+    return []
+
+
+def _names_in(expr: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+class _FnUnit:
+    """One function/method: its params and body, keyed for the
+    storing-parameter fix point (methods keyed by bare name as well —
+    hot-module classes are a closed world, so a ``rep.fleet_commit``
+    receiver resolves by method name)."""
+
+    def __init__(self, mod: ModuleInfo, qual: tuple, fn: ast.FunctionDef):
+        self.mod = mod
+        self.qual = qual
+        self.fn = fn
+        a = fn.args
+        self.params = [p.arg for p in (a.posonlyargs + a.args)]
+        self.kwonly = [p.arg for p in a.kwonlyargs]
+        self.storing: set[str] = set()  # param names this fn parks
+
+
+def _project_units(project: Project) -> dict[str, list[_FnUnit]]:
+    """bare function/method name -> units (all modules; names merge)."""
+    units: dict[str, list[_FnUnit]] = {}
+    for name in sorted(project.modules):
+        mod = project.modules[name]
+        for qual, fn in iter_function_defs(mod.tree):
+            units.setdefault(fn.name, []).append(_FnUnit(mod, qual, fn))
+    return units
+
+
+def _make_param_storing(units: dict[str, list[_FnUnit]]):
+    """``(callee leaf, positional index | None, kwarg | None) -> parks?``
+    over the current fix-point state. Callees merge across classes by
+    bare name (the hot modules are a closed world — conservative)."""
+
+    def param_storing(leaf: str, index: int | None, kw: str | None) -> bool:
+        for u in units.get(leaf, ()):
+            names = u.params
+            if kw is not None:
+                if kw in u.storing:
+                    return True
+                continue
+            if index is None:
+                continue
+            # method receivers burn params[0] == "self" at a call site
+            # like obj.m(a): positional arg 0 -> params[1]
+            for off in (0, 1):
+                j = index + off
+                if j < len(names) and names[j] in u.storing and (
+                    off == 0 or (names and names[0] == "self")
+                ):
+                    return True
+        return False
+
+    return param_storing
+
+
+def _storing_fixpoint(units: dict[str, list[_FnUnit]]) -> None:
+    """Mark params that escape into storage, propagating through
+    project-internal calls until stable (bounded: monotone over a
+    finite set)."""
+    param_storing = _make_param_storing(units)
+    changed = True
+    while changed:
+        changed = False
+        for us in units.values():
+            for u in us:
+                tracked = set(u.params) | set(u.kwonly)
+                before = len(u.storing)
+                u.storing |= _stored_names(u.fn, tracked, param_storing)
+                if len(u.storing) != before:
+                    changed = True
+
+
+def _stored_names(
+    fn: ast.FunctionDef, tracked: set[str], param_storing
+) -> set[str]:
+    """Names from ``tracked`` that this function's body parks: stored
+    to an attribute/subscript, appended/put to a container, or passed
+    at a storing position of another function. One level of local
+    aliasing (``q = p``) is followed."""
+    alias_of: dict[str, str] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Name):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and node.value.id in tracked:
+                    alias_of[t.id] = node.value.id
+
+    def root(name: str) -> str | None:
+        if name in tracked:
+            return name
+        return alias_of.get(name)
+
+    def roots_in(expr: ast.AST) -> set[str]:
+        out: set[str] = set()
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name):
+                r = root(n.id)
+                if r is not None:
+                    out.add(r)
+        return out
+
+    stored: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    stored |= roots_in(node.value)
+        elif isinstance(node, ast.Call):
+            leaf = _call_leaf(node)
+            if leaf in _EXTERNAL_STORING_LEAVES or leaf in MUTATOR_METHODS:
+                for a in node.args:
+                    stored |= roots_in(a)
+                for kw in node.keywords:
+                    stored |= roots_in(kw.value)
+                continue
+            for i, a in enumerate(node.args):
+                for r in roots_in(a):
+                    if param_storing(leaf, i, None):
+                        stored.add(r)
+            for kw in node.keywords:
+                for r in roots_in(kw.value):
+                    if param_storing(leaf, None, kw.arg):
+                        stored.add(r)
+    return stored
+
+
+# ----------------------------------------------------------------------
+# heavy locals of one enclosing function
+
+
+def _heavy_locals(fn: ast.FunctionDef) -> set[str]:
+    """Locals (and params) of ``fn`` holding kernel results / store
+    pytrees. Transitive through plain-name and ``.state``-leaf
+    assignments."""
+    heavy: set[str] = set()
+    a = fn.args
+    for p in a.posonlyargs + a.args + a.kwonlyargs:
+        ann = p.annotation
+        ann_name = (
+            (_dotted(ann) or "").rsplit(".", 1)[-1]
+            if ann is not None else ""
+        )
+        if p.arg in _HEAVY_PARAM_NAMES or ann_name.endswith("Store"):
+            heavy.add(p.arg)
+
+    def value_heavy(expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in heavy
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id in heavy | {"self"}:
+                return _attr_is_heavy(expr.attr)
+            return False
+        if isinstance(expr, ast.Call):
+            leaf = _call_leaf(expr)
+            if leaf in _KERNEL_LEAVES or leaf.startswith("jit_"):
+                return True
+            return False
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(value_heavy(e) for e in expr.elts)
+        return False
+
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not value_heavy(node.value):
+                continue
+            for t in node.targets:
+                for name in _assigned_names(t):
+                    if name not in heavy:
+                        heavy.add(name)
+                        changed = True
+    return heavy
+
+
+# ----------------------------------------------------------------------
+# closures: captures, factories, escapes
+
+
+def _bound_names(fn: "ast.FunctionDef | ast.Lambda") -> set[str]:
+    a = fn.args
+    bound = {
+        p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)
+    }
+    if a.vararg:
+        bound.add(a.vararg.arg)
+    if a.kwarg:
+        bound.add(a.kwarg.arg)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    bound |= set(_assigned_names(t))
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign, ast.For)):
+                bound |= set(_assigned_names(node.target))
+            elif isinstance(node, ast.comprehension):
+                bound |= set(_assigned_names(node.target))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bound.add(node.name)
+    return bound
+
+
+def _free_names(fn: "ast.FunctionDef | ast.Lambda") -> set[str]:
+    bound = _bound_names(fn)
+    free: set[str] = set()
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if node.id not in bound:
+                    free.add(node.id)
+    return free
+
+
+def _self_reads_heavy(fn: "ast.FunctionDef | ast.Lambda") -> str | None:
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and _attr_is_heavy(node.attr)
+            ):
+                return node.attr
+    return None
+
+
+def _heavy_defaults(
+    fn: "ast.FunctionDef | ast.Lambda", heavy: set[str]
+) -> str | None:
+    """A default-arg value that is the bare heavy name (or its
+    state/store leaf) re-widens the capture — ``ins=res.n_ins_row``
+    narrows to a leaf and is green, ``r=res`` / ``s=res.state`` pin the
+    whole pytree."""
+    for d in fn.args.defaults + [d for d in fn.args.kw_defaults if d]:
+        if isinstance(d, ast.Name) and d.id in heavy:
+            return d.id
+        if (
+            isinstance(d, ast.Attribute)
+            and isinstance(d.value, ast.Name)
+            and d.value.id in heavy
+            and _attr_is_heavy(d.attr)
+        ):
+            return f"{d.value.id}.{d.attr}"
+    return None
+
+
+def _closure_heavies(
+    fn: "ast.FunctionDef | ast.Lambda", heavy: set[str]
+) -> list[str]:
+    """Descriptions of every heavy thing this closure holds."""
+    out: list[str] = []
+    for name in sorted(_free_names(fn) & heavy):
+        out.append(f"free variable {name!r} (kernel result / store pytree)")
+    self_attr = _self_reads_heavy(fn)
+    if self_attr is not None and "self" in _free_names(fn):
+        out.append(f"self.{self_attr} through captured self")
+    d = _heavy_defaults(fn, heavy)
+    if d is not None:
+        out.append(f"default argument bound to {d}")
+    return out
+
+
+def _returned_nested(fn: ast.FunctionDef) -> "list[ast.AST]":
+    """Nested defs/lambdas this function returns (closure factory)."""
+    local = {
+        n.name: n
+        for n in ast.walk(fn)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n is not fn
+    }
+    out: list[ast.AST] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            v = node.value
+            if isinstance(v, ast.Lambda):
+                out.append(v)
+            elif isinstance(v, ast.Name) and v.id in local:
+                out.append(local[v.id])
+    return out
+
+
+def check_leaks(project: Project) -> list[Finding]:
+    units = _project_units(project)
+    _storing_fixpoint(units)
+    param_storing = _make_param_storing(units)
+    findings: list[Finding] = []
+    for mod_name in sorted(project.modules):
+        mod = project.modules[mod_name]
+        if mod_name.rsplit(".", 1)[-1] not in _HOT_LEAVES:
+            continue
+        # outer functions only: closures are analysed within their
+        # outermost scope (captures resolve against its heavy locals),
+        # so each closure is reported at most once
+        for qual, fn in outer_function_defs(mod.tree):
+            findings.extend(
+                _function_findings(mod, qual, fn, param_storing)
+            )
+    return findings
+
+
+def _function_findings(
+    mod: ModuleInfo, qual: tuple, fn: ast.FunctionDef, param_storing
+) -> list[Finding]:
+    heavy = _heavy_locals(fn)
+    if not heavy and not any(
+        isinstance(n, ast.Attribute)
+        and isinstance(n.value, ast.Name)
+        and n.value.id == "self"
+        for n in ast.walk(fn)
+    ):
+        return []
+
+    # nested closures directly under fn (deeper nesting is analysed when
+    # iter_function_defs reaches the inner def as its own unit)
+    closures: dict[str, ast.AST] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+            closures[node.name] = node
+
+    def closure_payload(c: "ast.FunctionDef | ast.Lambda") -> list[str]:
+        """Heavy captures of the closure itself plus — for a factory —
+        of the closure it returns."""
+        out = _closure_heavies(c, heavy)
+        if isinstance(c, ast.FunctionDef):
+            for inner in _returned_nested(c):
+                for h in _closure_heavies(inner, heavy):
+                    if h not in out:
+                        out.append(h)
+        return out
+
+    findings: list[Finding] = []
+    flagged: set[int] = set()
+
+    def name_of(c) -> str:
+        base = ".".join(qual)
+        if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return f"{base}.{c.name}"
+        return f"{base}.<lambda>"
+
+    def report(c, sink: str) -> None:
+        if id(c) in flagged:
+            return
+        payload = closure_payload(c)
+        if not payload:
+            return
+        flagged.add(id(c))
+        findings.append(Finding(
+            mod.rel, c.lineno, RULE,
+            f"closure {name_of(c)} escapes its defining scope ({sink}) "
+            f"capturing {payload[0]} — it pins superseded device buffers "
+            f"across the deferral window (the PR 9 ingest bug class); "
+            f"narrow the capture to count/scalar leaves via default-arg "
+            f"capture ({mod.name})",
+        ))
+
+    def escaping_exprs(expr: ast.AST, sink: str) -> None:
+        """Mark closures referenced by ``expr`` as escaping via ``sink``.
+        A call of a closure factory carries the factory's payload."""
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id in closures:
+                report(closures[n.id], sink)
+            elif isinstance(n, ast.Lambda):
+                report(n, sink)
+            elif (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Name)
+                and n.func.id in closures
+                and _returned_nested(closures[n.func.id])
+            ):
+                report(closures[n.func.id], sink)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    escaping_exprs(node.value, "stored to an attribute/container")
+        elif isinstance(node, ast.Call):
+            leaf = _call_leaf(node)
+            if leaf in _EXTERNAL_STORING_LEAVES or leaf in MUTATOR_METHODS:
+                for a in list(node.args) + [kw.value for kw in node.keywords]:
+                    escaping_exprs(a, f"handed to .{leaf}(...)")
+                continue
+            for i, a in enumerate(node.args):
+                if param_storing(leaf, i, None):
+                    escaping_exprs(a, f"deferred by {leaf}(...)")
+            for kw in node.keywords:
+                if param_storing(leaf, None, kw.arg):
+                    escaping_exprs(kw.value, f"deferred by {leaf}(...)")
+    return findings

@@ -1,0 +1,571 @@
+"""SHAPE001/SHAPE002 — recompile discipline at jit dispatch sites.
+
+The batched-fleet design only pays off while every hot dispatch hits a
+warm XLA cache; the tree's discipline for that is structural —
+**data-dependent Python sizes** (``len()`` of a drained group, a
+message count, a member list) must pass through a **tier/pad function**
+(``pow2_tier`` / ``pow4_tier`` / ``stack_entry_slices``'s ``lanes=``)
+before they become an operand shape, and **static (hashable) jit
+arguments** must come from the closed geometry-key vocabulary. Nothing
+enforced either until now; the runtime compile-cache audit
+(``utils/jitcache.py``, ``crdt_jit_compiles_total{name=...}``) is the
+dynamic cross-check of the same invariant.
+
+- **SHAPE001** — a jit dispatch operand whose shape derives from a raw
+  data-dependent size:
+
+  * an array constructor (``np.full``/``zeros``/``ones``/``empty``/
+    ``arange``) sized by ``len(...)``-tainted arithmetic, whose result
+    flows into a jit dispatch (``jit_*`` / the hash kernel table /
+    model kernels);
+  * ``stack_entry_slices(...)`` with a raw (or missing) ``lanes=`` —
+    the exact member count mints one executable per distinct
+    occupancy;
+  * a list stacked by ``stack_states``/``stack_pytrees``/
+    ``jit_stack_pytrees`` without the pad-to-tier idiom
+    (``lst += [lst[0]] * (lanes - len(lst))``).
+
+  ``.shape``/``.size`` reads are NOT raw (they mirror an existing
+  operand's compiled geometry); a tier call sanitises its whole
+  argument expression.
+
+- **SHAPE002** — a static argument at a ``static_argnames`` jit call
+  site outside the geometry-key vocabulary: tier-function results,
+  constants, store geometry attributes (``table_size``,
+  ``probe_window``, ``num_buckets``, …), arithmetic/min/max over
+  those, or values forwarded from a parameter (checked at the caller's
+  own site). A novel ad-hoc static value — ``lanes=len(msgs)``,
+  ``lanes=self._seq`` — mints a fresh executable per value and is red.
+
+Scope: the jit-dispatch shell modules (replica / fleet / binned_map /
+hash_store / transition) for SHAPE001; every module for SHAPE002
+(static wrappers are discovered project-wide from
+``jax.jit``/``named_jit`` assignments, decorators, and the lazy kernel
+table's name→statics dict).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.crdtlint.engine import Finding, ModuleInfo, Project, _dotted
+from tools.crdtlint.rules import iter_function_defs, outer_function_defs
+
+RULE_SHAPE = "SHAPE001"
+RULE_STATIC = "SHAPE002"
+
+#: modules whose jit-dispatch argument construction is SHAPE001-checked
+_SHELL_LEAVES = {"replica", "fleet", "binned_map", "hash_store", "transition"}
+
+#: tier/pad sanitiser seeds (import-resolved; aliases like ``_pow2``
+#: follow the import table). Any call to one of these sanitises its
+#: whole argument expression.
+_TIER_SEEDS = {"pow2_tier", "pow4_tier"}
+
+#: array constructors whose first argument is a shape
+_SHAPE_CTORS = {"full", "zeros", "ones", "empty", "arange"}
+
+#: declared pad functions: name -> (lanes kwarg, positional index)
+_PAD_FNS = {"stack_entry_slices": ("lanes", 1)}
+
+#: stacking entry points whose list-argument length is a compile shape
+_STACK_FNS = {"stack_states", "stack_pytrees", "jit_stack_pytrees"}
+
+#: store geometry attributes allowed as static-arg vocabulary
+_GEOMETRY_ATTRS = {
+    "num_buckets", "bin_capacity", "replica_capacity", "table_size",
+    "probe_window", "shape", "ndim", "size",
+}
+
+
+def _leaf(chain: str) -> str:
+    return chain.rsplit(".", 1)[-1]
+
+
+def _call_leaf(node: ast.Call) -> str:
+    return _leaf(_dotted(node.func) or "") or (
+        node.func.attr if isinstance(node.func, ast.Attribute) else ""
+    )
+
+
+# ----------------------------------------------------------------------
+# sanitiser discovery: tier seeds + project functions that return them
+
+
+def _tier_names(project: Project, mod: ModuleInfo) -> set[str]:
+    """Names that denote a tier sanitiser in ``mod``: the seeds, any
+    import alias of a seed, and any project function every return of
+    which is itself a tier call (``_dense_lanes`` style, one level)."""
+    names = set(_TIER_SEEDS)
+    for alias, imp in mod.imports.items():
+        if imp[0] == "sym" and imp[2] in _TIER_SEEDS:
+            names.add(alias)
+    for fname, fn in mod.functions.items():
+        rets = [
+            n.value for n in ast.walk(fn)
+            if isinstance(n, ast.Return) and n.value is not None
+        ]
+        if rets and all(
+            isinstance(r, ast.Call) and _call_leaf(r) in names for r in rets
+        ):
+            names.add(fname)
+    return names
+
+
+# ----------------------------------------------------------------------
+# raw-size taint (SHAPE001)
+
+
+class _Taint:
+    """Per-function raw-size and hazard-array taint."""
+
+    def __init__(self, mod: ModuleInfo, fn: ast.FunctionDef, tiers: set[str]):
+        self.tiers = tiers
+        a = fn.args
+        self.params = {
+            p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)
+        }
+        if a.vararg:
+            self.params.add(a.vararg.arg)
+        if a.kwarg:
+            self.params.add(a.kwarg.arg)
+        self.raw: set[str] = set()  # locals holding raw data-dep sizes
+        self.hazard: set[str] = set()  # locals holding raw-shaped arrays
+        self.padded: set[str] = set()  # list locals padded to a tier
+        self.list_sources: dict[str, ast.AST] = {}  # name -> list expr
+        self._scan(fn)
+
+    # -- expression classification -------------------------------------
+
+    def expr_raw(self, expr: ast.AST) -> bool:
+        """Does this scalar expression carry a raw data-dependent size?"""
+        if isinstance(expr, ast.Call):
+            leaf = _call_leaf(expr)
+            if leaf in self.tiers:
+                return False  # tier call sanitises its whole argument
+            if leaf == "len":
+                return True
+            if leaf in ("min", "max", "abs", "sum", "int", "round"):
+                return any(self.expr_raw(a) for a in expr.args)
+            return False
+        if isinstance(expr, ast.Name):
+            return expr.id in self.raw
+        if isinstance(expr, ast.BinOp):
+            return self.expr_raw(expr.left) or self.expr_raw(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self.expr_raw(expr.operand)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self.expr_raw(e) for e in expr.elts)
+        if isinstance(expr, ast.IfExp):
+            return self.expr_raw(expr.body) or self.expr_raw(expr.orelse)
+        # constants, .shape/.size reads, params, unknown calls: not raw
+        return False
+
+    def _ctor_hazard(self, expr: ast.AST) -> bool:
+        """An array constructor sized by a raw expression?"""
+        return (
+            isinstance(expr, ast.Call)
+            and _call_leaf(expr) in _SHAPE_CTORS
+            and bool(expr.args)
+            and self.expr_raw(expr.args[0])
+        )
+
+    def expr_hazard(self, expr: ast.AST) -> bool:
+        """Does this expression reference (or build) a raw-shaped array?"""
+        if self._ctor_hazard(expr):
+            return True
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id in self.hazard:
+                return True
+            if n is not expr and self._ctor_hazard(n):
+                return True
+        return False
+
+    # -- statement scan -------------------------------------------------
+
+    @staticmethod
+    def _scalar_kill(expr: ast.AST) -> bool:
+        """int()/float()/bool() etc. collapse arrays to scalars — the
+        hazard (a shape) does not survive them."""
+        return (
+            isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Name)
+            and expr.func.id in ("int", "float", "bool", "len", "str")
+        )
+
+    def _scan(self, fn: ast.FunctionDef) -> None:
+        stmts = list(ast.walk(fn))
+        changed = True
+        while changed:
+            changed = False
+            for node in stmts:
+                if isinstance(node, ast.Assign):
+                    value = node.value
+                    names = [
+                        t.id for t in node.targets if isinstance(t, ast.Name)
+                    ]
+                    for t in node.targets:
+                        if isinstance(t, ast.Tuple):
+                            names.extend(
+                                e.id for e in t.elts if isinstance(e, ast.Name)
+                            )
+                    if not names:
+                        continue
+                    if isinstance(value, (ast.List, ast.ListComp)):
+                        for n in names:
+                            self.list_sources.setdefault(n, value)
+                    if self.expr_raw(value):
+                        for n in names:
+                            if n not in self.raw:
+                                self.raw.add(n)
+                                changed = True
+                    if not self._scalar_kill(value) and self.expr_hazard(value):
+                        for n in names:
+                            if n not in self.hazard:
+                                self.hazard.add(n)
+                                changed = True
+                elif isinstance(node, ast.AugAssign) and isinstance(
+                    node.target, ast.Name
+                ):
+                    if self.expr_raw(node.value) and node.target.id not in self.raw:
+                        # the pad idiom's own `lst += [..] * (V - len(lst))`
+                        # is handled below, not tainted here
+                        if not self._pad_stmt(node):
+                            self.raw.add(node.target.id)
+                            changed = True
+        # pad idiom: lst += [..] * (V - len(lst)) / lst.extend(same) with
+        # a NON-raw tier V — runs after the taint fix point so a raw V
+        # (`lanes = len(members)`) does not count as padding
+        for node in stmts:
+            tgt = self._pad_stmt(node)
+            if tgt is not None:
+                self.padded.add(tgt)
+
+    def _pad_stmt(self, node: ast.AST) -> str | None:
+        """``lst += [..] * (V - len(lst))`` (or ``.extend`` of the same)
+        with a sanitised tier ``V`` — returns the padded list's name."""
+        target = width = None
+        if isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Add):
+            if isinstance(node.target, ast.Name):
+                target, width = node.target.id, node.value
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "extend"
+            and isinstance(node.func.value, ast.Name)
+            and node.args
+        ):
+            target, width = node.func.value.id, node.args[0]
+        if target is None or not (
+            isinstance(width, ast.BinOp) and isinstance(width.op, ast.Mult)
+        ):
+            return None
+        if isinstance(width.left, (ast.List, ast.ListComp)):
+            count = width.right
+        elif isinstance(width.right, (ast.List, ast.ListComp)):
+            count = width.left
+        else:
+            count = width.right
+        # the count is typically (V - len(lst)): V must be sanitised —
+        # the complementary len() term is the idiom itself, not a taint
+        if isinstance(count, ast.BinOp) and isinstance(count.op, ast.Sub):
+            return target if not self.expr_raw(count.left) else None
+        return target if not self.expr_raw(count) else None
+
+
+# ----------------------------------------------------------------------
+# jit dispatch sites
+
+
+def _is_jit_dispatch(node: ast.Call) -> bool:
+    """A call that launches a jitted executable: ``jit_*`` names, the
+    hash backend's lazy kernel table (``jit.<kernel>``), and model
+    kernel seams (``model.merge_rows`` / ``model.fleet_*``)."""
+    chain = _dotted(node.func) or ""
+    leaf = _leaf(chain)
+    if leaf.startswith("jit_"):
+        return True
+    parts = chain.split(".")
+    if len(parts) >= 2 and parts[-2] == "jit":
+        return True
+    if leaf.startswith("fleet_") and len(parts) >= 2:
+        return True
+    return False
+
+
+def check_shape001(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod_name in sorted(project.modules):
+        mod = project.modules[mod_name]
+        if mod_name.rsplit(".", 1)[-1] not in _SHELL_LEAVES:
+            continue
+        tiers = _tier_names(project, mod)
+        for qual, fn in outer_function_defs(mod.tree):
+            # nested defs are walked within their parent: closures share
+            # the enclosing scope's taint
+            taint = _Taint(mod, fn, tiers)
+            name = ".".join(qual)
+            seen_lines: set[int] = set()
+
+            def report(line: int, msg: str) -> None:
+                if line in seen_lines:
+                    return
+                seen_lines.add(line)
+                findings.append(Finding(mod.rel, line, RULE_SHAPE, msg))
+
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                leaf = _call_leaf(node)
+                if leaf in _PAD_FNS:
+                    kwname, pos = _PAD_FNS[leaf]
+                    lanes = next(
+                        (kw.value for kw in node.keywords if kw.arg == kwname),
+                        node.args[pos] if len(node.args) > pos else None,
+                    )
+                    if lanes is None:
+                        report(node.lineno, (
+                            f"{leaf}(...) without {kwname}= stacks the exact "
+                            f"member count — one fresh XLA executable per "
+                            f"distinct occupancy; pad through pow2_tier "
+                            f"({mod.name}.{name})"
+                        ))
+                    elif taint.expr_raw(lanes):
+                        report(node.lineno, (
+                            f"{leaf}(..., {kwname}=...) receives a raw "
+                            f"data-dependent size (len()-derived) — an "
+                            f"unbounded-recompile hazard; route it through "
+                            f"pow2_tier/pow4_tier ({mod.name}.{name})"
+                        ))
+                    continue
+                if leaf in _STACK_FNS:
+                    for a in node.args:
+                        starred = isinstance(a, ast.Starred)
+                        tgt = a.value if starred else a
+                        if (
+                            isinstance(tgt, ast.Name)
+                            and tgt.id in taint.hazard
+                        ):
+                            report(node.lineno, (
+                                f"{leaf}(...) stacks a raw-shaped operand "
+                                f"{tgt.id!r} ({mod.name}.{name}) — pad to a "
+                                f"lane tier first"
+                            ))
+                            continue
+                        # list-length = the stacked leading axis: the
+                        # list must be tier-padded in this scope (or be
+                        # a parameter, padded by the caller)
+                        list_like = starred or (
+                            isinstance(tgt, ast.Name)
+                            and isinstance(
+                                taint.list_sources.get(tgt.id),
+                                (ast.List, ast.ListComp),
+                            )
+                        )
+                        if (
+                            list_like
+                            and isinstance(tgt, ast.Name)
+                            and tgt.id not in taint.padded
+                            and tgt.id not in taint.params
+                        ):
+                            report(node.lineno, (
+                                f"{leaf}({'*' if starred else ''}{tgt.id}) "
+                                f"stacks a list whose length was never "
+                                f"padded to a lane tier (`{tgt.id} += "
+                                f"[{tgt.id}[0]] * (lanes - len(...))`) — "
+                                f"one executable per distinct member count "
+                                f"({mod.name}.{name})"
+                            ))
+                    continue
+                if _is_jit_dispatch(node):
+                    for a in list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]:
+                        if taint.expr_hazard(a):
+                            report(node.lineno, (
+                                f"jit dispatch {leaf}(...) takes an operand "
+                                f"built with a raw data-dependent shape "
+                                f"(len()-derived, never tiered through "
+                                f"pow2_tier/pow4_tier) — every distinct "
+                                f"size mints a fresh XLA executable "
+                                f"({mod.name}.{name})"
+                            ))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# SHAPE002: static-arg vocabulary
+
+
+def _static_wrappers(project: Project) -> dict[str, set[str]]:
+    """bare jitted name -> static param names, discovered from
+    ``NAME = jax.jit/named_jit(fn, static_argnames=…)`` assignments,
+    ``@partial(jax.jit, static_argnames=…)`` decorators, and lazy
+    kernel tables ({"kernel": ("lanes",), …} dict feeding a
+    ``static_argnames=`` jit call in the same function)."""
+
+    def static_names(call: ast.Call) -> set[str]:
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                if isinstance(kw.value, (ast.Tuple, ast.List)):
+                    return {
+                        e.value for e in kw.value.elts
+                        if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    }
+                if isinstance(kw.value, ast.Constant) and isinstance(
+                    kw.value.value, str
+                ):
+                    return {kw.value.value}
+        return set()
+
+    out: dict[str, set[str]] = {}
+    for mod in project.modules.values():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if _call_leaf(node.value) in ("jit", "named_jit"):
+                    statics = static_names(node.value)
+                    if statics:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                out.setdefault(t.id, set()).update(statics)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and _call_leaf(dec) in (
+                        "jit", "partial",
+                    ):
+                        statics = static_names(dec)
+                        if statics:
+                            out.setdefault(node.name, set()).update(statics)
+        # lazy kernel tables: a dict literal of name -> static tuple in
+        # a function that also jits with static_argnames=<that dict>.get
+        for _qual, fn in iter_function_defs(mod.tree):
+            has_static_jit = any(
+                isinstance(n, ast.Call)
+                and _call_leaf(n) in ("jit", "named_jit")
+                and any(kw.arg == "static_argnames" for kw in n.keywords)
+                for n in ast.walk(fn)
+            )
+            if not has_static_jit:
+                continue
+            for n in ast.walk(fn):
+                if not isinstance(n, ast.Dict):
+                    continue
+                for k, v in zip(n.keys, n.values):
+                    if (
+                        isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                        and isinstance(v, (ast.Tuple, ast.List))
+                        and v.elts
+                        and all(
+                            isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                            for e in v.elts
+                        )
+                    ):
+                        out.setdefault(k.value, set()).update(
+                            e.value for e in v.elts
+                        )
+    return out
+
+
+def _vocab_ok(
+    expr: ast.AST,
+    taint_params: set[str],
+    assigns: dict[str, ast.AST],
+    tiers: set[str],
+    _depth: int = 0,
+) -> bool:
+    """Is this static-arg expression inside the geometry vocabulary?"""
+    if _depth > 8:
+        return False
+    if isinstance(expr, ast.Constant):
+        return True
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in _GEOMETRY_ATTRS or (
+            isinstance(expr.value, ast.Attribute)
+            and expr.value.attr in _GEOMETRY_ATTRS
+        )
+    if isinstance(expr, ast.Subscript):
+        return _vocab_ok(expr.value, taint_params, assigns, tiers, _depth + 1)
+    if isinstance(expr, ast.Name):
+        if expr.id in taint_params:
+            return True  # forwarded: checked at the caller's site
+        src = assigns.get(expr.id)
+        if src is None:
+            return False
+        return _vocab_ok(src, taint_params, assigns, tiers, _depth + 1)
+    if isinstance(expr, ast.Call):
+        leaf = _call_leaf(expr)
+        if leaf in tiers:
+            return True
+        if leaf in ("min", "max"):
+            return all(
+                _vocab_ok(a, taint_params, assigns, tiers, _depth + 1)
+                for a in expr.args
+            )
+        return False
+    if isinstance(expr, ast.BinOp):
+        return _vocab_ok(
+            expr.left, taint_params, assigns, tiers, _depth + 1
+        ) and _vocab_ok(expr.right, taint_params, assigns, tiers, _depth + 1)
+    if isinstance(expr, (ast.ListComp, ast.GeneratorExp)):
+        return _vocab_ok(expr.elt, taint_params, assigns, tiers, _depth + 1)
+    if isinstance(expr, ast.IfExp):
+        return _vocab_ok(
+            expr.body, taint_params, assigns, tiers, _depth + 1
+        ) and _vocab_ok(expr.orelse, taint_params, assigns, tiers, _depth + 1)
+    return False
+
+
+def check_shape002(project: Project) -> list[Finding]:
+    wrappers = _static_wrappers(project)
+    if not wrappers:
+        return []
+    findings: list[Finding] = []
+    for mod_name in sorted(project.modules):
+        mod = project.modules[mod_name]
+        tiers = _tier_names(project, mod)
+        for qual, fn in outer_function_defs(mod.tree):
+            a = fn.args
+            params = {
+                p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)
+            }
+            # lambdas forward their own params too
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Lambda):
+                    la = n.args
+                    params |= {
+                        p.arg for p in (la.posonlyargs + la.args + la.kwonlyargs)
+                    }
+            assigns: dict[str, ast.AST] = {}
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            assigns[t.id] = n.value
+            name = ".".join(qual)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                leaf = _call_leaf(node)
+                statics = wrappers.get(leaf)
+                if not statics:
+                    continue
+                for kw in node.keywords:
+                    if kw.arg in statics and not _vocab_ok(
+                        kw.value, params, assigns, tiers
+                    ):
+                        findings.append(Finding(
+                            mod.rel, node.lineno, RULE_STATIC,
+                            f"static argument {kw.arg}= at jit call site "
+                            f"{leaf}(...) is outside the geometry-key "
+                            f"vocabulary (tier functions, constants, store "
+                            f"geometry fields) — a novel static value "
+                            f"mints one executable per value "
+                            f"({mod.name}.{name})",
+                        ))
+    return findings
+
+
+def check_shapes(project: Project) -> list[Finding]:
+    return check_shape001(project) + check_shape002(project)

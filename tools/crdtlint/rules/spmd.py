@@ -1,0 +1,195 @@
+"""SPMD001 — shard_map readiness of transition-contract modules.
+
+The ROADMAP's top open item lifts the fleet's ``fleet_*`` transitions
+from ``vmap`` to ``shard_map`` over a replica-sharded mesh (DrJAX-style
+mapped anti-entropy). That lift only works if the pure transition layer
+is *mesh-liftable*, and three construct classes silently break it:
+
+- **host callbacks** (``io_callback`` / ``pure_callback`` /
+  ``jax.debug.print`` / ``jax.debug.callback``): a host round trip per
+  shard serialises exactly the dispatch overlap the mesh exists for —
+  and some backends cannot lift it at all. Red anywhere in a
+  transition-contract module.
+- **Python branching on replica-axis-dependent sizes**: an ``if`` /
+  ``while`` on ``len(states)`` or ``states.…shape[0]`` in a ``fleet_*``
+  function branches on the GLOBAL axis size, which under ``shard_map``
+  differs from the per-shard size — the trace silently bakes in
+  whichever world compiled first.
+- **implicit global reductions over the replica axis**: an axis-free
+  ``jnp.sum(x)`` / ``x.max()`` at the top level of a ``fleet_*``
+  function reduces across the leading replica axis today; under
+  ``shard_map`` it reduces only the local shard — a silent semantic
+  change that needs an explicit collective (``psum``/``pmax``).
+  Reductions inside vmapped inner functions are per-lane and lift
+  unchanged, so nested-def bodies are exempt.
+
+Scope: the transition-contract modules (``runtime/transition*``,
+``ops/hash_map`` — the same contract markers SYNC001 uses for its
+every-function-is-a-jit-root rule). Findings name the construct so the
+mesh-sharding PR starts from a verified-clean seam.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.crdtlint.engine import Finding, ModuleInfo, Project, _dotted
+from tools.crdtlint.rules import outer_function_defs
+from tools.crdtlint.rules.hostsync import _TRANSITION_MODULE_MARKERS
+
+RULE = "SPMD001"
+
+#: call leaves that are host callbacks wherever they appear
+_CALLBACK_LEAVES = {"io_callback", "pure_callback", "host_callback"}
+#: dotted-chain suffixes that are host callbacks (the jax.debug family)
+_DEBUG_SUFFIXES = ("debug.print", "debug.callback", "debug.breakpoint")
+#: axis-free reduction leaves that implicitly fold the replica axis
+_REDUCTION_LEAVES = {
+    "sum", "max", "min", "mean", "prod", "any", "all", "argmax", "argmin",
+}
+#: functions with a leading replica axis by contract (the mesh seam)
+_AXIS_FN_PREFIXES = ("fleet_", "stack_")
+
+
+def _is_transition_module(mod: ModuleInfo) -> bool:
+    return any(m in mod.name + "." for m in _TRANSITION_MODULE_MARKERS)
+
+
+def _callback_findings(mod: ModuleInfo) -> list[Finding]:
+    out: list[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _dotted(node.func) or ""
+        leaf = chain.rsplit(".", 1)[-1]
+        if leaf in _CALLBACK_LEAVES or any(
+            chain.endswith(s) for s in _DEBUG_SUFFIXES
+        ):
+            out.append(Finding(
+                mod.rel, node.lineno, RULE,
+                f"host callback {chain or leaf}(...) in transition-contract "
+                f"module {mod.name}: shard_map cannot lift a host round "
+                f"trip onto a replica-sharded mesh — move it to the I/O "
+                f"shell",
+            ))
+    return out
+
+
+def _param_names(fn: ast.FunctionDef) -> set[str]:
+    a = fn.args
+    return {
+        p.arg
+        for p in (a.posonlyargs + a.args + a.kwonlyargs)
+    } | ({a.vararg.arg} if a.vararg else set())
+
+
+def _rooted_at(node: ast.AST, names: set[str]) -> bool:
+    """Is the attribute/subscript/call chain rooted at one of ``names``?"""
+    while True:
+        if isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Name):
+            return node.id in names
+        else:
+            return False
+
+
+def _axis_size_read(test: ast.AST, params: set[str]) -> str | None:
+    """A ``len(param…)`` / ``param….shape[…]`` read inside a branch
+    test — the axis-size-dependent value; returns a description."""
+    for n in ast.walk(test):
+        if (
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Name)
+            and n.func.id == "len"
+            and n.args
+            and _rooted_at(n.args[0], params)
+        ):
+            return "len() of a replica-axis operand"
+        if (
+            isinstance(n, ast.Attribute)
+            and n.attr == "shape"
+            and _rooted_at(n.value, params)
+        ):
+            return ".shape of a replica-axis operand"
+    return None
+
+
+def _nested_node_ids(fn: ast.FunctionDef) -> set[int]:
+    """ids of every node living inside a nested def/lambda of ``fn``."""
+    inner: set[int] = set()
+    for sub in ast.walk(fn):
+        if sub is fn:
+            continue
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            for n in ast.walk(sub):
+                if n is not sub:
+                    inner.add(id(n))
+    return inner
+
+
+def _axis_fn_findings(
+    mod: ModuleInfo, qual: tuple, fn: ast.FunctionDef
+) -> list[Finding]:
+    out: list[Finding] = []
+    params = _param_names(fn)
+    name = ".".join(qual)
+    nested = _nested_node_ids(fn)
+    for node in ast.walk(fn):
+        if id(node) in nested:
+            # vmapped inner functions are per-lane: their branches and
+            # reductions lift unchanged
+            continue
+        if isinstance(node, (ast.If, ast.While)):
+            desc = _axis_size_read(node.test, params)
+            if desc is not None:
+                out.append(Finding(
+                    mod.rel, node.lineno, RULE,
+                    f"Python {'while' if isinstance(node, ast.While) else 'if'} "
+                    f"on {desc} in {mod.name}.{name}: under shard_map the "
+                    f"per-shard axis size differs from the global one — "
+                    f"the trace bakes in whichever compiled first; make "
+                    f"the branch a lax.cond or hoist it to the shell",
+                ))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if not isinstance(f, ast.Attribute) or f.attr not in _REDUCTION_LEAVES:
+                continue
+            # axis-free forms only: an explicit axis= (or positional
+            # axis) names the folded axis and survives the lift
+            has_axis = any(kw.arg == "axis" for kw in node.keywords)
+            chain = _dotted(f) or ""
+            head = chain.split(".", 1)[0]
+            if head in ("jnp", "np", "jax", "lax", "numpy"):
+                has_axis = has_axis or len(node.args) > 1
+                operand = node.args[0] if node.args else None
+            else:
+                has_axis = has_axis or bool(node.args)
+                operand = f.value
+            if has_axis or operand is None or not _rooted_at(operand, params):
+                continue
+            out.append(Finding(
+                mod.rel, node.lineno, RULE,
+                f"axis-free reduction .{f.attr}() over a replica-axis "
+                f"operand in {mod.name}.{name}: under shard_map this "
+                f"folds only the local shard — name the axes (axis=...) "
+                f"or use an explicit collective (psum/pmax) for the "
+                f"replica axis",
+            ))
+    return out
+
+
+def check_spmd(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for name in sorted(project.modules):
+        mod = project.modules[name]
+        if not _is_transition_module(mod):
+            continue
+        findings.extend(_callback_findings(mod))
+        for qual, fn in outer_function_defs(mod.tree):
+            if not fn.name.startswith(_AXIS_FN_PREFIXES):
+                continue
+            findings.extend(_axis_fn_findings(mod, qual, fn))
+    return findings
