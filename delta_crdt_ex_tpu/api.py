@@ -178,6 +178,7 @@ def start_fleet(
     names: "list | None" = None,
     min_batch: int = 2,
     store: "str | None" = None,
+    mesh=None,
     **opts,
 ) -> Fleet:
     """Start ``n`` replicas served by ONE batched event loop (ISSUE 6:
@@ -216,7 +217,19 @@ def start_fleet(
     :class:`~delta_crdt_ex_tpu.runtime.metrics.Observability`): every
     member registers its varz/health/metric sources and the fleet adds
     its own tick-freshness health check plus occupancy / ragged-fill
-    gauges — see :func:`start_link` for the plane's full surface."""
+    gauges — see :func:`start_link` for the plane's full surface.
+
+    ``mesh=`` (ISSUE 13, additive — default off) shards the fleet's
+    batched dispatches over a 1-D replica-sharded device mesh: pass a
+    ``jax.sharding.Mesh`` over the ``"replicas"`` axis
+    (:func:`delta_crdt_ex_tpu.utils.devices.fleet_mesh` builds one), an
+    int shard count, or ``True`` for the detected-topology default.
+    Hot kernels then run as ``shard_map`` twins, resident stacked
+    states stay device-sharded between ticks, and sync-tick messages
+    between co-mesh members deliver as device-side ``ppermute``
+    rotations (only off-mesh destinations take the TCP/frame path).
+    Semantics are bit-for-bit the vmap fleet's — state, WAL bytes,
+    acks and wire bytes (``tests/test_mesh_fleet.py``)."""
     if names is not None and len(names) != n:
         raise ValueError(f"{len(names)} names for {n} replicas")
     opts.setdefault("sync_interval", DEFAULT_SYNC_INTERVAL)
@@ -236,7 +249,7 @@ def start_fleet(
         if names is not None:
             member["name"] = names[i]
         replicas.append(Replica(crdt_module, **member))
-    fleet = Fleet(replicas, min_batch=min_batch, obs=obs_plane)
+    fleet = Fleet(replicas, min_batch=min_batch, obs=obs_plane, mesh=mesh)
     if threaded:
         fleet.start()
     return fleet

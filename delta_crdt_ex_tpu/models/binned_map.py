@@ -592,6 +592,37 @@ class BinnedAWLWWMap:
     # by leaf length alone (possibly mixing backends in one stack) and
     # calls transition.jit_fleet_tree_from_leaves directly.
 
+    # -- mesh-sharded fleet seam (ISSUE 13): the same batched forms
+    # lifted onto a replica-sharded device mesh. Lane k of the sharded
+    # dispatch is bit-for-bit the vmapped (and therefore the solo)
+    # kernel on lane k's inputs — the fleet's mesh mode swaps these in
+    # without touching any bookkeeping.
+
+    @classmethod
+    def mesh_fleet_merge_rows(cls, mesh, states, slices):
+        from delta_crdt_ex_tpu.runtime import transition
+
+        return transition.jit_mesh_fleet_merge_rows(mesh, states, slices)
+
+    @classmethod
+    def mesh_fleet_extract_rows(cls, mesh, states, rows):
+        from delta_crdt_ex_tpu.runtime import transition
+
+        return transition.jit_mesh_fleet_extract_rows(mesh, states, rows), None
+
+    @classmethod
+    def mesh_fleet_extract_own_delta(
+        cls, mesh, states, rows, self_slots, gid_selfs, lo
+    ):
+        from delta_crdt_ex_tpu.runtime import transition
+
+        return (
+            transition.jit_mesh_fleet_interval_slices(
+                mesh, states, rows, self_slots, gid_selfs, lo
+            ),
+            None,
+        )
+
 
 class AWSet(BinnedAWLWWMap):
     """Add-wins observed-remove set — the second δ-CRDT of the reference

@@ -519,6 +519,48 @@ class HashAWLWWMap:
     # (no fleet_tree_from_leaves seam: leaf digests are bit-identical
     # across backends — the fleet's batched tree build is model-agnostic)
 
+    # -- mesh-sharded fleet seam (ISSUE 13): the dense sizing pass rides
+    # the mesh too, and the bucket-wide static lane tier is computed
+    # from the gathered counts exactly as the vmap forms do — padding
+    # lanes count zero alive entries, so the tier never moves with the
+    # shard padding and lane trims stay bit-for-bit the solo tiers.
+
+    @classmethod
+    def mesh_fleet_merge_rows(cls, mesh, states, slices):
+        from delta_crdt_ex_tpu.runtime import transition
+
+        return transition.jit_mesh_fleet_hash_merge_rows(mesh, states, slices)
+
+    @classmethod
+    def mesh_fleet_extract_rows(cls, mesh, states, rows):
+        from delta_crdt_ex_tpu.runtime import transition
+
+        counts = np.asarray(
+            transition.jit_mesh_fleet_hash_row_counts(mesh, states, rows)
+        )
+        tiers = [_dense_lanes(c) for c in counts]
+        sl = transition.jit_mesh_fleet_hash_extract_rows(
+            mesh, states, rows, lanes=max(tiers)
+        )
+        return sl, tiers
+
+    @classmethod
+    def mesh_fleet_extract_own_delta(
+        cls, mesh, states, rows, self_slots, gid_selfs, lo
+    ):
+        from delta_crdt_ex_tpu.runtime import transition
+
+        counts = np.asarray(
+            transition.jit_mesh_fleet_hash_own_delta_counts(
+                mesh, states, rows, self_slots, lo
+            )
+        )
+        tiers = [_dense_lanes(c) for c in counts]
+        sl = transition.jit_mesh_fleet_hash_interval_slices(
+            mesh, states, rows, self_slots, gid_selfs, lo, lanes=max(tiers)
+        )
+        return sl, tiers
+
 
 class HashAWSet(HashAWLWWMap):
     """Add-wins observed-remove set over the hash store (the

@@ -58,6 +58,23 @@ bit-for-bit the per-member loop's. Outbound messages to a co-located
 peer process that negotiated the fleet-frame capability aggregate into
 one :class:`~delta_crdt_ex_tpu.runtime.sync.FleetFrameMsg` TCP frame
 per endpoint per tick (see ``tcp_transport._FLEETF``).
+
+MESH MODE (ISSUE 13, ``mesh=``): the same fleet lifted onto a 1-D
+replica-sharded device mesh. Every batched dispatch swaps its ``vmap``
+form for the ``shard_map`` twin
+(:mod:`delta_crdt_ex_tpu.runtime.transition` ``mesh_fleet_*``) with
+the replica-lane tier padded to a shard multiple (the existing
+lane/row padding discipline, so SHAPE001 stays green), resident
+stacked states stay device-sharded between ticks (a batched result is
+already laid out for the next dispatch — no gather/rescatter), and a
+sync tick's outbound messages bound for a co-mesh member ride the
+interconnect as ``ppermute`` rotations through the intra-mesh delivery
+plane (:mod:`delta_crdt_ex_tpu.runtime.meshplane`) — only off-mesh
+destinations fall back to the frame collector / direct send. Lane k of
+a sharded dispatch is bit-for-bit the vmapped kernel on lane k's
+inputs, so mesh-vs-vmap fleets are identical on state bits, WAL bytes,
+ack streams and wire bytes (``tests/test_mesh_fleet.py``, ``bench.py
+--fleet --mesh`` assert it in-run).
 """
 
 from __future__ import annotations
@@ -78,6 +95,7 @@ from delta_crdt_ex_tpu.runtime import (
     telemetry,
     transition,
 )
+from delta_crdt_ex_tpu.runtime.meshplane import MeshPlane
 from delta_crdt_ex_tpu.runtime.replica import (
     Replica,
     _LaneLevels,
@@ -216,7 +234,9 @@ class Fleet:
     interval checkpoints) plus the batched ingress drain.
     """
 
-    def __init__(self, replicas: list, *, min_batch: int = 2, obs=None):
+    def __init__(
+        self, replicas: list, *, min_batch: int = 2, obs=None, mesh=None
+    ):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
         for r in replicas:
@@ -237,6 +257,57 @@ class Fleet:
         #: smallest batch worth stacking: below it the per-replica
         #: grouped path is strictly cheaper (nothing to amortise)
         self.min_batch = max(2, int(min_batch))
+        #: mesh mode (ISSUE 13): a 1-D replica-sharded device mesh
+        #: (pass a jax Mesh, an int shard count, or True for the
+        #: detected-topology default), default off — hot dispatches
+        #: then ride the shard_map twins, resident stacked states stay
+        #: device-sharded, and intra-mesh sync-tick entries deliver as
+        #: ppermute rotations (runtime/meshplane.py)
+        self._mesh = None
+        self._mesh_shards = 1
+        self._mesh_sharding = None
+        self._mesh_plane = None
+        self._mesh_members_per_shard = 0.0
+        if mesh is not None and mesh is not False:
+            from delta_crdt_ex_tpu.utils import devices as _devices
+
+            if mesh is True or isinstance(mesh, int):
+                mesh = _devices.fleet_mesh(None if mesh is True else mesh)
+            if tuple(mesh.axis_names) != (transition.MESH_AXIS,):
+                raise ValueError(
+                    f"fleet mesh must be 1-D over the "
+                    f"{transition.MESH_AXIS!r} axis, got {mesh.axis_names}"
+                )
+            shards = int(mesh.devices.size)
+            if shards & (shards - 1):
+                raise ValueError(
+                    f"fleet mesh size must be a power of two, got {shards}"
+                )
+            self._mesh = mesh
+            self._mesh_shards = shards
+            self._mesh_sharding = transition.replica_sharding(mesh)
+            self._mesh_plane = MeshPlane(mesh)
+            self._mesh_plane.assign(
+                [(r.addr, r.transport) for r in self.replicas]
+            )
+            # membership is fixed at construction: snapshot the ratio so
+            # stats() never calls into the plane under the fleet lock
+            self._mesh_members_per_shard = (
+                self._mesh_plane.members_per_shard()
+            )
+        # snapshot once: the device topology is immutable after backend
+        # init, and stats() must not re-enumerate devices under the
+        # fleet lock on every scrape (same rationale as the
+        # members-per-shard snapshot above)
+        from delta_crdt_ex_tpu.utils import devices as _devices_mod
+
+        self._mesh_topology = _devices_mod.detected_topology()
+        #: intra-mesh delivery plane accounting (read by stats() under
+        #: the fleet lock; the sync-tick thread writes them there too)
+        self._mesh_intra_entries = 0
+        self._mesh_fallback_entries = 0
+        self._mesh_permuted_bytes = 0
+        self._mesh_exchanges = 0
         self._lock = threading.Lock()
         #: resident stacked states per batch bucket: members tuple →
         #: (per-member state versions at stack time, stacked pytree,
@@ -400,12 +471,24 @@ class Fleet:
                 continue
             self._dispatch_bucket(members)
 
+    def _lane_tier(self, n: int) -> int:
+        """Replica-axis compile tier for one batched dispatch: the pow2
+        lane tier, padded up to the mesh shard count in mesh mode so
+        the lane axis splits evenly across shards (padding lanes merge/
+        extract nothing, exactly like the vmap form's). Shard counts
+        are pow2, so one tier call covers both modes — and crdtlint's
+        SHAPE001 sanitiser inference sees a pure tier function."""
+        return pow2_tier(max(n, self._mesh_shards), floor=2)
+
     def _stacked_states(self, reps: list, lanes: int):
         """The stacked input states for one bucket — reused from the
         previous dispatch's RESULT when no member's state moved since
         (``_state_version`` match), else restacked from the members'
         per-replica states. Padding lanes replicate member 0 (their
-        slices are all-padding: the merge is a no-op on them)."""
+        slices are all-padding: the merge is a no-op on them). In mesh
+        mode a fresh stack is placed replica-sharded over the mesh; a
+        cached result is ALREADY sharded (shard_map output), which is
+        what keeps resident state device-sharded between ticks."""
         key = tuple(id(r) for r in reps) + (lanes,)
         versions = [r._state_version for r in reps]
         with self._lock:
@@ -414,12 +497,15 @@ class Fleet:
             return hit[1], key, versions
         states = [r.state for r in reps]
         states += [states[0]] * (lanes - len(states))
-        return transition.stack_states(states), key, versions
+        stacked = transition.stack_states(states)
+        if self._mesh_sharding is not None:
+            stacked = jax.device_put(stacked, self._mesh_sharding)
+        return stacked, key, versions
 
     def _dispatch_bucket(self, members: list) -> None:
         t0 = time.perf_counter()
         n = len(members)
-        lanes = pow2_tier(n, floor=2)
+        lanes = self._lane_tier(n)
         sl, real_rows = stack_entry_slices([st.sl for st in members], lanes=lanes)
         reps = [st.rep for st in members]
         stacked_in, cache_key, _versions = self._stacked_states(reps, lanes)
@@ -427,8 +513,14 @@ class Fleet:
         # model's batch-compatibility key (backend tag included), so all
         # members of a bucket share one store backend and its vmapped
         # merge form — binned buckets split at lane-tier boundaries,
-        # hash buckets only at a table rehash
-        res = reps[0].model.fleet_merge_rows(stacked_in, sl)
+        # hash buckets only at a table rehash. Mesh mode (ISSUE 13)
+        # swaps in the shard_map twin over the same stacked operands.
+        if self._mesh is None:
+            res = reps[0].model.fleet_merge_rows(stacked_in, sl)
+        else:
+            res = reps[0].model.mesh_fleet_merge_rows(
+                self._mesh, stacked_in, sl
+            )
         # hash backend: per-lane window pressure rides the same readback
         # so the growth advisory below costs no extra device sync
         wfill = getattr(res, "max_window_fill", None)
@@ -597,20 +689,28 @@ class Fleet:
         for items in ctr_groups.values():
             if len(items) < self.min_batch:
                 continue  # _eager_jobs rebuilds those solo
-            # pad to a pow2 lane tier (lane 0 replicated) — group
-            # membership varies tick to tick with cache invalidation,
-            # and an exact-size stack would recompile per distinct size
-            lanes = pow2_tier(len(items), floor=2)
+            # pad to a pow2 lane tier (lane 0 replicated; mesh mode
+            # rounds up to a shard multiple) — group membership varies
+            # tick to tick with cache invalidation, and an exact-size
+            # stack would recompile per distinct size
+            lanes = self._lane_tier(len(items))
             tables = [e.state.ctx_max for e in items]
             tables += [tables[0]] * (lanes - len(items))
             slots = np.zeros(lanes, np.int32)
             slots[: len(items)] = [e.rep.self_slot for e in items]
-            cols = np.asarray(
-                transition.jit_fleet_own_ctr_columns(
-                    transition.jit_stack_pytrees(*tables),
-                    jnp.asarray(slots),
+            stacked_tables = transition.jit_stack_pytrees(*tables)
+            if self._mesh is None:
+                cols = np.asarray(
+                    transition.jit_fleet_own_ctr_columns(
+                        stacked_tables, jnp.asarray(slots)
+                    )
                 )
-            )
+            else:
+                cols = np.asarray(
+                    transition.jit_mesh_fleet_own_ctr_columns(
+                        self._mesh, stacked_tables, jnp.asarray(slots)
+                    )
+                )
             for lane, e in enumerate(items):
                 e.own_ctr = cols[lane]
 
@@ -669,12 +769,16 @@ class Fleet:
             # pow2 lane tier like the ctr refresh above: a per-size
             # stack/build compile on the periodic path would stall a
             # steady-state fleet every time the due set's size moved
-            lanes = pow2_tier(len(items), floor=2)
+            lanes = self._lane_tier(len(items))
             leaves = [e.state.leaf for e in items]
             leaves += [leaves[0]] * (lanes - len(items))
-            levels = transition.jit_fleet_tree_from_leaves(
-                transition.jit_stack_pytrees(*leaves)
-            )
+            stacked_leaves = transition.jit_stack_pytrees(*leaves)
+            if self._mesh is None:
+                levels = transition.jit_fleet_tree_from_leaves(stacked_leaves)
+            else:
+                levels = transition.jit_mesh_fleet_tree_from_leaves(
+                    self._mesh, stacked_leaves
+                )
             stack = _StackedLevels(levels)
             stack.prefetch(max(e.rep.levels_per_round for e in items))
             n_tree_batched += len(items)
@@ -685,7 +789,15 @@ class Fleet:
         # (version-guarded), emit every job through the shared
         # _emit_push_job tail (cursor advance, send accounting), open
         # the walk rounds (the _outstanding / _sync_open_seq bookkeeping
-        # — unchanged), with sends aggregating into fleet frames
+        # — unchanged), with sends aggregating into fleet frames. Mesh
+        # mode interposes the intra-mesh delivery plane: co-mesh
+        # destinations buffer for the tick's ppermute exchange (ordered
+        # delivery at flush), everything else takes the collector path.
+        exchange = (
+            self._mesh_plane.begin_tick()
+            if self._mesh_plane is not None
+            else None
+        )
         collectors: dict[int, _FrameCollector] = {}
         for ent in staged:
             rep = ent.rep
@@ -694,12 +806,18 @@ class Fleet:
                 coll = collectors[id(rep.transport)] = _FrameCollector(
                     rep.transport
                 )
+            if exchange is None:
+                send = coll.send
+            else:
+                # default-arg capture of JUST the collector send (the
+                # lambda outlives this iteration's loop variables)
+                send = lambda to, m, _f=coll.send: exchange.send_via(_f, to, m)  # noqa: E731
             with rep._lock:
                 if ent.solo:
                     # stale member: the solo path end-to-end (its own
                     # plan, extraction, emission and walks)
-                    rep._push_deltas(coll.send)
-                    rep._open_walks(coll.send)
+                    rep._push_deltas(send)
+                    rep._open_walks(send)
                     continue
                 tv = lane_trees.get(id(rep))
                 if (
@@ -712,8 +830,13 @@ class Fleet:
                     sl = extracted.get(id(job))
                     if sl is None:
                         sl = rep._extract_push_job(job)
-                    rep._emit_push_job(job, sl, coll.send)
-                rep._open_walks(coll.send)
+                    rep._emit_push_job(job, sl, send)
+                rep._open_walks(send)
+
+        # phase 3.5 — the intra-mesh exchange: rotate buffered co-mesh
+        # entries along the replica axis and deliver every buffered
+        # message in global send order (the host-path arrival order)
+        mesh_stats = exchange.flush() if exchange is not None else None
 
         # phase 4 — ship the aggregated fleet frames, one per endpoint
         frames = frame_members = 0
@@ -737,6 +860,25 @@ class Fleet:
             self._egress_tree_batched += n_tree_batched
             self._egress_frames += frames
             self._egress_frame_members += frame_members
+            if mesh_stats is not None:
+                self._mesh_intra_entries += mesh_stats["intra_entries"]
+                self._mesh_fallback_entries += mesh_stats["fallback_entries"]
+                self._mesh_permuted_bytes += mesh_stats["permuted_bytes"]
+                self._mesh_exchanges += mesh_stats["exchanges"]
+        if mesh_stats is not None and telemetry.has_handlers(
+            telemetry.MESH_EXCHANGE
+        ):
+            telemetry.execute(
+                telemetry.MESH_EXCHANGE,
+                {
+                    "intra_entries": mesh_stats["intra_entries"],
+                    "fallback_entries": mesh_stats["fallback_entries"],
+                    "permuted_bytes": mesh_stats["permuted_bytes"],
+                    "exchanges": mesh_stats["exchanges"],
+                    "shards": self._mesh_shards,
+                },
+                {"fleet": id(self)},
+            )
         if telemetry.has_handlers(telemetry.FLEET_EGRESS):
             telemetry.execute(
                 telemetry.FLEET_EGRESS,
@@ -764,7 +906,7 @@ class Fleet:
         slice ``_emit_push_job`` fans out."""
         model = items[0][0].model
         n = len(items)
-        lanes = pow2_tier(n, floor=2)
+        lanes = self._lane_tier(n)
         states = [st for _rep, st, _job in items]
         states += [states[0]] * (lanes - n)
         stacked = transition.jit_stack_pytrees(*states)
@@ -780,15 +922,29 @@ class Fleet:
                 slots[k] = rep.self_slot
                 gids[k] = rep.node_id
                 lo[k] = job.lo
-            sl, tiers = model.fleet_extract_own_delta(
-                stacked,
-                jnp.asarray(rows),
-                jnp.asarray(slots),
-                jnp.asarray(gids),
-                jnp.asarray(lo),
-            )
-        else:
+            if self._mesh is None:
+                sl, tiers = model.fleet_extract_own_delta(
+                    stacked,
+                    jnp.asarray(rows),
+                    jnp.asarray(slots),
+                    jnp.asarray(gids),
+                    jnp.asarray(lo),
+                )
+            else:
+                sl, tiers = model.mesh_fleet_extract_own_delta(
+                    self._mesh,
+                    stacked,
+                    jnp.asarray(rows),
+                    jnp.asarray(slots),
+                    jnp.asarray(gids),
+                    jnp.asarray(lo),
+                )
+        elif self._mesh is None:
             sl, tiers = model.fleet_extract_rows(stacked, jnp.asarray(rows))
+        else:
+            sl, tiers = model.mesh_fleet_extract_rows(
+                self._mesh, stacked, jnp.asarray(rows)
+            )
         host = jax.device_get(sl)  # one transfer for the whole bucket
         for k, (_rep, _st, job) in enumerate(items):
             extracted[id(job)] = _lane_slice(
@@ -910,7 +1066,26 @@ class Fleet:
                 ),
                 "fallbacks": dict(self._fallbacks),
                 "egress": self._egress_stats_held(),
+                "mesh": self._mesh_stats_held(),
             }
+
+    def _mesh_stats_held(self) -> dict:
+        """Mesh-mode observability (caller holds the fleet lock): shard
+        layout, intra-mesh-vs-fallback delivery counters, permuted
+        bytes, and the detected device/mesh topology (the
+        ``test_multihost_spmd`` PROBE_SHAPE field vocabulary, snapshot
+        at construction) so every stats consumer — /varz, bench
+        artifacts — is self-describing about the hardware it ran on."""
+        return {
+            "enabled": self._mesh is not None,
+            "shards": self._mesh_shards if self._mesh is not None else 0,
+            "members_per_shard": self._mesh_members_per_shard,
+            "intra_entries": self._mesh_intra_entries,
+            "fallback_entries": self._mesh_fallback_entries,
+            "permuted_bytes": self._mesh_permuted_bytes,
+            "exchanges": self._mesh_exchanges,
+            "topology": self._mesh_topology,
+        }
 
     def _egress_stats_held(self) -> dict:
         """Batched-egress observability (caller holds the fleet lock):

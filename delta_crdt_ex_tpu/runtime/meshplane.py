@@ -1,0 +1,215 @@
+"""Intra-mesh delivery plane (ISSUE 13): sync-tick messages whose
+destination member lives on the same fleet mesh move as device-side
+collectives instead of bouncing through the host transport.
+
+A mesh-mode :class:`~delta_crdt_ex_tpu.runtime.fleet.Fleet` keeps its
+members' stacked states replica-sharded over a 1-D device mesh; its
+sync-tick egress, however, still produced per-member ``EntriesMsg``
+sends that a co-located member would receive through the host mailbox —
+on real hardware a device→host→device bounce for bytes that both live
+and are consumed on the mesh. This module is the fleet-frame idea
+(:class:`~delta_crdt_ex_tpu.runtime.sync.FleetFrameMsg`, PR 10) turned
+inward: a tick's outbound messages bound for a co-mesh member are
+buffered, their slice columns ride one ``lax.ppermute`` rotation per
+(shard distance, buffer geometry) group along the ``replicas`` mesh
+axis (:func:`delta_crdt_ex_tpu.runtime.transition.mesh_plane_rotate`),
+and the per-entry host bookkeeping — message envelopes, payload dicts,
+mailbox delivery, and through them WAL records, acks and telemetry at
+the receiver — fans out exactly as the TCP path does. Only off-mesh
+destinations fall back to the PR 10 frame collector / direct send.
+
+Semantics are bit-for-bit the host path's:
+
+- a rotation moves each entry's columns intact (integer lattice
+  columns; a permute changes placement, never values), so the
+  delivered ``EntriesMsg`` bodies are byte-identical to a pass-by-
+  reference local send;
+- ALL buffered messages (openers included — their digest blocks are
+  host control metadata and ship as-is) deliver at :meth:`flush` in
+  global send order, which is exactly the per-destination arrival
+  order the un-planed tick produces (members emit in staged order,
+  and nothing else touches the mailboxes mid-tick);
+- ``send`` returning True commits the message to the tick's exchange;
+  a drop after that (receiver died mid-tick) is the same lossy-
+  transport case as a ``_SenderConn``/frame drop — cursors may run
+  ahead by one tick and the periodic sync repair re-covers.
+
+Compile discipline: rotation buffers pad their entry-slot axis to a
+pow2 tier and group by exact column geometry, so distinct compiles of
+the rotate kernel are bounded by (bucket slice geometry) × (mesh size
+− 1 shard distances) — the compile-cache audit covers the kernel via
+its ``named_jit`` registration, and ``bench.py --fleet --mesh`` gates
+zero steady-state compiles in-run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from delta_crdt_ex_tpu.models.binned import pow2_tier
+from delta_crdt_ex_tpu.runtime import sync as sync_proto, transition
+
+#: EntriesMsg columns that ride the device exchange; ``rows`` stays host
+#: control metadata, mirroring ``Replica._slice_arrays`` where the row
+#: index vector never leaves the host even on the device plane
+_EXCHANGE_COLS = (
+    "key", "valh", "ts", "node", "ctr", "alive",
+    "ctx_rows", "ctx_lo", "ctx_gid",
+)
+
+
+class MeshPlane:
+    """Per-fleet routing table + exchange factory. The fleet assigns
+    its member addresses once (:meth:`assign`, re-run on membership
+    change); each sync tick then opens one :class:`_TickExchange`
+    whose ``send`` is handed to the members' emission tails in place
+    of the frame collector's."""
+
+    __slots__ = ("mesh", "shards", "sharding", "_members")
+
+    def __init__(self, mesh) -> None:
+        self.mesh = mesh
+        self.shards = int(mesh.devices.size)
+        self.sharding = transition.replica_sharding(mesh)
+        self._members: dict = {}  # addr -> (shard, transport)
+
+    def assign(self, members: list) -> None:
+        """Block-assign member ``(addr, transport)`` pairs to mesh
+        shards — the same leading-axis block layout the resident
+        stacked state shards with (lane tier padded to a shard
+        multiple, so every shard owns a contiguous lane block)."""
+        lanes = max(pow2_tier(len(members), floor=2), self.shards)
+        per = lanes // self.shards
+        self._members = {
+            addr: (i // per, transport)
+            for i, (addr, transport) in enumerate(members)
+        }
+
+    def shard_of(self, addr) -> "int | None":
+        """The mesh shard hosting ``addr``'s lane, or None when the
+        address is not a member of this mesh (the TCP-fallback set)."""
+        ent = self._members.get(addr)
+        return None if ent is None else ent[0]
+
+    def members_per_shard(self) -> float:
+        n = len(self._members)
+        return round(n / self.shards, 3) if self.shards else 0.0
+
+    def begin_tick(self) -> "_TickExchange":
+        return _TickExchange(self)
+
+
+class _TickExchange:
+    """One sync tick's buffered exchange: routes sends, runs the
+    rotation collectives at :meth:`flush`, and returns the tick's
+    delivery stats."""
+
+    __slots__ = ("plane", "entries", "fallback_entries", "passthrough")
+
+    def __init__(self, plane: MeshPlane) -> None:
+        self.plane = plane
+        self.entries: list = []  # (to, dst_shard, msg) in send order
+        self.fallback_entries = 0
+        #: co-mesh EntriesMsg the exchange could not carry (device-plane
+        #: jax-array bodies from device-pinned members, or a non-member
+        #: sender): delivered host-side in order, counted as FALLBACK so
+        #: the crdt_mesh_* counters never read an idle plane while it
+        #: moved all the traffic
+        self.passthrough = 0
+
+    def send_via(self, fallback, to, msg) -> bool:
+        """Route one outbound message: co-mesh destinations buffer for
+        the tick's exchange; everything else takes ``fallback`` (the
+        member's frame-collector send — the unchanged PR 10 path)."""
+        shard = self.plane.shard_of(to)
+        if shard is None:
+            if isinstance(msg, sync_proto.EntriesMsg):
+                self.fallback_entries += 1
+            return fallback(to, msg)
+        self.entries.append((to, shard, msg))
+        return True
+
+    def _exchange_groups(self):
+        """Collective-eligible entries grouped by (shard distance,
+        column geometry); same-shard entries (distance 0) are already
+        device-local and need no permute."""
+        groups: dict = {}
+        same_shard = 0
+        for idx, (_to, dst, msg) in enumerate(self.entries):
+            if not isinstance(msg, sync_proto.EntriesMsg):
+                continue
+            src = self.plane.shard_of(getattr(msg, "frm", None))
+            if src is None:
+                self.passthrough += 1
+                continue
+            a = msg.arrays
+            if not all(
+                isinstance(a.get(c), np.ndarray) for c in _EXCHANGE_COLS
+            ):
+                # device-plane or legacy body: host passthrough, counted
+                # as fallback (the exchange did not carry it)
+                self.passthrough += 1
+                continue
+            shift = (dst - src) % self.plane.shards
+            if shift == 0:
+                same_shard += 1
+                continue
+            geom = tuple(
+                (c, a[c].shape, a[c].dtype.str) for c in _EXCHANGE_COLS
+            )
+            groups.setdefault((shift, geom), []).append((idx, src, dst, a))
+        return groups, same_shard
+
+    def flush(self) -> dict:
+        """Run the rotation collectives, then deliver every buffered
+        message in global send order. Returns the tick's stats."""
+        groups, same_shard = self._exchange_groups()
+        delivered_cols: dict = {}  # entry idx -> exchanged column dict
+        permuted_bytes = 0
+        exchanges = 0
+        shards = self.plane.shards
+        for (shift, geom), items in groups.items():
+            slot_of: list = []
+            per_src: dict = {}
+            for _idx, src, _dst, _a in items:
+                j = per_src.get(src, 0)
+                per_src[src] = j + 1
+                slot_of.append(j)
+            depth = pow2_tier(max(per_src.values()))
+            bufs = {
+                c: np.zeros((shards, depth) + shape, np.dtype(dt))
+                for c, shape, dt in geom
+            }
+            for (idx, src, _dst, a), j in zip(items, slot_of):
+                for c in _EXCHANGE_COLS:
+                    bufs[c][src, j] = a[c]
+            shipped = jax.device_put(bufs, self.plane.sharding)
+            rotated = transition.jit_mesh_plane_rotate(
+                self.plane.mesh, shift, shipped
+            )
+            host = jax.device_get(rotated)
+            permuted_bytes += sum(b.nbytes for b in bufs.values())
+            exchanges += 1
+            for (idx, _src, dst, _a), j in zip(items, slot_of):
+                delivered_cols[idx] = {
+                    c: host[c][dst, j] for c in _EXCHANGE_COLS
+                }
+
+        intra_entries = same_shard + len(delivered_cols)
+        members = self.plane._members
+        for idx, (to, _dst, msg) in enumerate(self.entries):
+            cols = delivered_cols.get(idx)
+            if cols is not None:
+                cols["rows"] = msg.arrays["rows"]
+                msg = dataclasses.replace(msg, arrays=cols)
+            members[to][1].send(to, msg)
+        self.entries.clear()
+        return {
+            "intra_entries": intra_entries,
+            "fallback_entries": self.fallback_entries + self.passthrough,
+            "permuted_bytes": permuted_bytes,
+            "exchanges": exchanges,
+        }

@@ -568,6 +568,25 @@ class MetricsBridge:
             "crdt_fleet_egress_seconds",
             "Batched egress tick wall time", ("fleet",),
         )
+        self.mesh_exchanges = c(
+            "crdt_mesh_exchanges_total",
+            "Intra-mesh ppermute exchange dispatches", ("fleet",),
+        )
+        self.mesh_intra_entries = c(
+            "crdt_mesh_intra_entries_total",
+            "Sync-tick entries delivered through the intra-mesh plane",
+            ("fleet",),
+        )
+        self.mesh_fallback_entries = c(
+            "crdt_mesh_fallback_entries_total",
+            "Sync-tick entries that fell back to the host/TCP path",
+            ("fleet",),
+        )
+        self.mesh_permuted_bytes = c(
+            "crdt_mesh_permuted_bytes_total",
+            "Bytes moved by intra-mesh ppermute rotations (padded buffers)",
+            ("fleet",),
+        )
         # monotone by construction (a tracing cache only grows), hence
         # the _total name despite the set-to-absolute gauge primitive:
         # the jitcache audit reports absolute per-root compile counts,
@@ -608,6 +627,7 @@ class MetricsBridge:
             (telemetry.CATCHUP_DONE, self._on_catchup_done),
             (telemetry.FLEET_DISPATCH, self._on_fleet_dispatch),
             (telemetry.FLEET_EGRESS, self._on_fleet_egress),
+            (telemetry.MESH_EXCHANGE, self._on_mesh_exchange),
             (telemetry.JIT_COMPILE, self._on_jit_compile),
         ]
 
@@ -758,6 +778,15 @@ class MetricsBridge:
             self.fleet_egress_frames._inc_held(lb, g("frames", 0))
             self.fleet_egress_frame_members._inc_held(lb, g("frame_members", 0))
             self.fleet_egress_seconds._observe_held(lb, g("duration_s", 0.0))
+
+    def _on_mesh_exchange(self, _event, meas, meta) -> None:
+        lb = (self._s(meta.get("fleet")),)
+        g = meas.get
+        with self._lock:
+            self.mesh_exchanges._inc_held(lb, g("exchanges", 0))
+            self.mesh_intra_entries._inc_held(lb, g("intra_entries", 0))
+            self.mesh_fallback_entries._inc_held(lb, g("fallback_entries", 0))
+            self.mesh_permuted_bytes._inc_held(lb, g("permuted_bytes", 0))
 
     def _on_jit_compile(self, _event, meas, meta) -> None:
         lb = (self._s(meta.get("name")),)
@@ -1046,6 +1075,15 @@ class Observability:
             "crdt_fleet_egress_bucket_occupancy",
             "Mean members per batched egress extraction bucket", ("fleet",),
         )
+        self._g_mesh_shards = g(
+            "crdt_mesh_shards",
+            "Mesh shard count of a mesh-mode fleet (0 = vmap mode)",
+            ("fleet",),
+        )
+        self._g_mesh_mps = g(
+            "crdt_mesh_members_per_shard",
+            "Mean fleet members per mesh shard", ("fleet",),
+        )
         # compile-cache audit (ISSUE 12): a scrape-time collector runs
         # the jitcache audit, which re-publishes EVERY named jit entry
         # root's absolute tracing-cache size through JIT_COMPILE
@@ -1170,6 +1208,10 @@ class Observability:
             self._g_fleet_egress_mpf.set(eg["members_per_frame"], fleet_lb)
             self._g_fleet_egress_fpt.set(eg["frames_per_tick"], fleet_lb)
             self._g_fleet_egress_occ.set(eg["avg_bucket_occupancy"], fleet_lb)
+            mesh = st.get("mesh")
+            if mesh is not None:
+                self._g_mesh_shards.set(mesh["shards"], fleet_lb)
+                self._g_mesh_mps.set(mesh["members_per_shard"], fleet_lb)
 
         fleet._obs_collector = collect
         self.registry.register_collector(collect)
@@ -1183,7 +1225,7 @@ class Observability:
         for gauge in (
             self._g_fleet_occupancy, self._g_fleet_fill, self._g_fleet_ticks,
             self._g_fleet_egress_mpf, self._g_fleet_egress_fpt,
-            self._g_fleet_egress_occ,
+            self._g_fleet_egress_occ, self._g_mesh_shards, self._g_mesh_mps,
         ):
             # same contract as unregister_replica: a stopped fleet must
             # not scrape as a stale last value forever

@@ -24,6 +24,7 @@ CATCHUP_CHUNK = ("delta_crdt", "catchup", "chunk")  # measurements: records, row
 CATCHUP_DONE = ("delta_crdt", "catchup", "done")  # measurements: chunks, duration_s, horizon_fallback; metadata: name, peer
 FLEET_DISPATCH = ("delta_crdt", "fleet", "dispatch")  # measurements: replicas, lanes, messages, rows, padded_rows, duration_s; metadata: fleet
 FLEET_EGRESS = ("delta_crdt", "fleet", "egress")  # measurements: members, jobs_batched, jobs_solo, dispatches, frames, frame_members, duration_s; metadata: fleet
+MESH_EXCHANGE = ("delta_crdt", "mesh", "exchange")  # measurements: intra_entries, fallback_entries, permuted_bytes, exchanges, shards; metadata: fleet
 JIT_COMPILE = ("delta_crdt", "jit", "compile")  # measurements: compiles (absolute tracing-cache size); metadata: name (jit entry root)
 
 def declared_events() -> tuple[tuple, ...]:

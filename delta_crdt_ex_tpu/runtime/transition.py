@@ -22,6 +22,19 @@ into :func:`fleet_merge_rows`. ``vmap`` adds no arithmetic of its own
 bit-for-bit the solo kernel on that lane's inputs — the property
 ``tests/test_fleet.py`` pins.
 
+The mesh forms (``mesh_fleet_*``, ISSUE 13) are the same fleet kernels
+lifted one axis further: ``shard_map`` over a 1-D replica-sharded
+``Mesh`` with the per-shard ``vmap`` form inside, so N stacked lanes
+split into ``mesh.devices.size`` device-resident blocks and each block
+runs the UNCHANGED vmapped kernel — lane k's math is identical whether
+its block holds 2 lanes or 256, which is why mesh-vs-vmap fleet runs
+are bit-for-bit (the SPMD001 lint family proves the kernels carry no
+host callbacks, replica-axis Python branches, or implicit axis
+reductions that would break under the lift). ``mesh_plane_rotate`` is
+the intra-mesh delivery plane's collective: one ``lax.ppermute``
+rotation of padded slice buffers along the replica axis
+(:mod:`delta_crdt_ex_tpu.runtime.meshplane`).
+
 Stacking helpers (:func:`stack_states`, :func:`index_state`) are pure
 pytree shuffles and live here so the shell never touches array layout.
 """
@@ -29,6 +42,26 @@ pytree shuffles and live here so the shell never touches array layout.
 from __future__ import annotations
 
 import jax
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map as _new_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs):
+        return _new_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+except ImportError:  # jax < 0.6 ships shard_map under experimental,
+    # with the replication check spelled check_rep instead of check_vma
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs):
+        return _old_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
 
 from delta_crdt_ex_tpu.models.binned import BinnedStore
 from delta_crdt_ex_tpu.models.hash_store import HashStore
@@ -205,6 +238,166 @@ jit_fleet_hash_extract_rows = named_jit(
 )
 jit_fleet_hash_interval_slices = named_jit(
     fleet_hash_interval_slices, static_argnames=("lanes",)
+)
+
+
+# ---------------------------------------------------------------------------
+# mesh-lifted fleet transitions (ISSUE 13): shard_map over a 1-D
+# replica-sharded mesh with the per-shard vmap form inside. The lanes
+# axis must be a multiple of the mesh size (the fleet pads lane tiers
+# to max(pow2, shards) — the same discipline as the lane/row padding,
+# so SHAPE001 stays green); every twin is registered in utils/jitcache
+# so the compile-cache audit covers the mesh seam too. The mesh rides
+# as a static argument: one tracing-cache entry per (mesh, geometry),
+# bounded exactly like the vmap forms' per-geometry entries.
+
+#: the 1-D fleet mesh axis (matches parallel/mesh_gossip.AXIS)
+MESH_AXIS = "replicas"
+
+
+def replica_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis replica sharding over the fleet mesh — the placement
+    of every resident stacked state and stacked slice in mesh mode."""
+    return NamedSharding(mesh, P(MESH_AXIS))
+
+
+def _lift(mesh: Mesh, fn):
+    """The mesh lift: ``fn`` (a vmapped ``fleet_*`` form) over per-shard
+    lane blocks. A single spec broadcasts over the argument and result
+    pytrees — every leaf carries the leading replica axis."""
+    spec = P(MESH_AXIS)
+    return shard_map(fn, mesh=mesh, in_specs=spec, out_specs=spec)
+
+
+def mesh_fleet_merge_rows(mesh, states, slices):
+    """:func:`fleet_merge_rows` sharded over the replica mesh: each
+    device merges its resident lane block, no cross-shard traffic (the
+    merge is lane-local; only the delivery plane permutes)."""
+    return _lift(mesh, fleet_merge_rows)(states, slices)
+
+
+def mesh_fleet_row_apply(mesh, states, self_slots, rows, op, key, valh, ts):
+    """:func:`fleet_row_apply` sharded over the replica mesh."""
+    return _lift(mesh, fleet_row_apply)(
+        states, self_slots, rows, op, key, valh, ts
+    )
+
+
+def mesh_fleet_extract_rows(mesh, states, rows):
+    """:func:`fleet_extract_rows` sharded over the replica mesh."""
+    return _lift(mesh, fleet_extract_rows)(states, rows)
+
+
+def mesh_fleet_interval_slices(mesh, states, rows, self_slots, gid_selfs, lo):
+    """:func:`fleet_interval_slices` sharded over the replica mesh."""
+    return _lift(mesh, fleet_interval_slices)(
+        states, rows, self_slots, gid_selfs, lo
+    )
+
+
+def mesh_fleet_tree_from_leaves(mesh, leaves):
+    """:func:`fleet_tree_from_leaves` sharded over the replica mesh."""
+    return _lift(mesh, fleet_tree_from_leaves)(leaves)
+
+
+def mesh_fleet_own_ctr_columns(mesh, ctx_max, self_slots):
+    """:func:`fleet_own_ctr_columns` sharded over the replica mesh."""
+    return _lift(mesh, fleet_own_ctr_columns)(ctx_max, self_slots)
+
+
+def mesh_fleet_hash_merge_rows(mesh, states, slices):
+    """:func:`fleet_hash_merge_rows` sharded over the replica mesh."""
+    return _lift(mesh, fleet_hash_merge_rows)(states, slices)
+
+
+def mesh_fleet_hash_row_counts(mesh, states, rows):
+    """:func:`fleet_hash_row_counts` sharded over the replica mesh."""
+    return _lift(mesh, fleet_hash_row_counts)(states, rows)
+
+
+def mesh_fleet_hash_own_delta_counts(mesh, states, rows, self_slots, lo):
+    """:func:`fleet_hash_own_delta_counts` sharded over the mesh."""
+    return _lift(mesh, fleet_hash_own_delta_counts)(
+        states, rows, self_slots, lo
+    )
+
+
+def mesh_fleet_hash_extract_rows(mesh, states, rows, lanes: int):
+    """:func:`fleet_hash_extract_rows` sharded over the replica mesh
+    (``lanes`` is the bucket-wide static dense tier, unchanged)."""
+    return _lift(mesh, lambda st, r: fleet_hash_extract_rows(st, r, lanes))(
+        states, rows
+    )
+
+
+def mesh_fleet_hash_interval_slices(
+    mesh, states, rows, self_slots, gid_selfs, lo, lanes: int
+):
+    """:func:`fleet_hash_interval_slices` sharded over the replica
+    mesh."""
+    return _lift(
+        mesh,
+        lambda st, r, ss, gs, lo_: fleet_hash_interval_slices(
+            st, r, ss, gs, lo_, lanes
+        ),
+    )(states, rows, self_slots, gid_selfs, lo)
+
+
+def mesh_plane_rotate(mesh, shift: int, buffers):
+    """The intra-mesh delivery plane's collective (ISSUE 13): rotate
+    every leaf of ``buffers`` (padded ``[shards, depth, ...]`` slice
+    column stacks) ``shift`` shards forward along the replica axis —
+    one ``lax.ppermute`` per column, so sync-tick entries bound for a
+    co-mesh member ride the interconnect instead of host TCP. The
+    permutation is a full static rotation: entries grouped by shard
+    distance share one dispatch, and the (mesh size − 1)-rotation
+    vocabulary bounds distinct compiles per buffer geometry."""
+    n = mesh.devices.size
+    perm = [(i, (i + shift) % n) for i in range(n)]
+
+    def rotate(tree):
+        return jax.tree.map(
+            lambda a: jax.lax.ppermute(a, MESH_AXIS, perm), tree
+        )
+
+    return _lift(mesh, rotate)(buffers)
+
+
+jit_mesh_fleet_merge_rows = named_jit(
+    mesh_fleet_merge_rows, static_argnames=("mesh",)
+)
+jit_mesh_fleet_row_apply = named_jit(
+    mesh_fleet_row_apply, static_argnames=("mesh",)
+)
+jit_mesh_fleet_extract_rows = named_jit(
+    mesh_fleet_extract_rows, static_argnames=("mesh",)
+)
+jit_mesh_fleet_interval_slices = named_jit(
+    mesh_fleet_interval_slices, static_argnames=("mesh",)
+)
+jit_mesh_fleet_tree_from_leaves = named_jit(
+    mesh_fleet_tree_from_leaves, static_argnames=("mesh",)
+)
+jit_mesh_fleet_own_ctr_columns = named_jit(
+    mesh_fleet_own_ctr_columns, static_argnames=("mesh",)
+)
+jit_mesh_fleet_hash_merge_rows = named_jit(
+    mesh_fleet_hash_merge_rows, static_argnames=("mesh",)
+)
+jit_mesh_fleet_hash_row_counts = named_jit(
+    mesh_fleet_hash_row_counts, static_argnames=("mesh",)
+)
+jit_mesh_fleet_hash_own_delta_counts = named_jit(
+    mesh_fleet_hash_own_delta_counts, static_argnames=("mesh",)
+)
+jit_mesh_fleet_hash_extract_rows = named_jit(
+    mesh_fleet_hash_extract_rows, static_argnames=("mesh", "lanes")
+)
+jit_mesh_fleet_hash_interval_slices = named_jit(
+    mesh_fleet_hash_interval_slices, static_argnames=("mesh", "lanes")
+)
+jit_mesh_plane_rotate = named_jit(
+    mesh_plane_rotate, static_argnames=("mesh", "shift")
 )
 
 

@@ -92,6 +92,70 @@ def force_cpu_devices(n: int) -> None:
         )
 
 
+def detected_topology() -> dict:
+    """The detected device/mesh shape, in the exact field vocabulary the
+    ``test_multihost_spmd`` capability probe prints (``PROBE_SHAPE``):
+    platform, global/local device counts, process count. Recorded into
+    ``Fleet.stats()`` and every benchmark artifact so CPU-vs-TPU
+    evidence under ``benchmarks/results/`` is self-describing.
+
+    Initialises the XLA backend if nothing has yet (callers that need
+    a forced device count must call :func:`force_cpu_devices` first).
+    """
+    import jax
+
+    return {
+        "platform": jax.default_backend(),
+        "global_devices": len(jax.devices()),
+        "local_devices": len(jax.local_devices()),
+        "processes": jax.process_count(),
+    }
+
+
+def mesh_shard_count(n_devices: int | None = None) -> int:
+    """Largest power-of-two shard count the detected (or given) device
+    count supports — the default width of :func:`fleet_mesh`. Power-of-
+    two only: the fleet's lane tiers are pow2, and lanes must divide
+    evenly into shards for the ``shard_map`` lift."""
+    if n_devices is None:
+        import jax
+
+        n_devices = len(jax.devices())
+    if n_devices < 1:
+        raise ValueError("no devices detected")
+    return 1 << (int(n_devices).bit_length() - 1)
+
+
+def fleet_mesh(shards: int | None = None, devices=None):
+    """A 1-D ``Mesh(devices[:shards], ("replicas",))`` for
+    ``Fleet(mesh=...)`` / ``start_fleet(..., mesh=...)`` (ISSUE 13).
+
+    ``shards`` defaults to the largest power of two the detected device
+    set supports (:func:`mesh_shard_count`); non-pow2 counts raise —
+    the fleet pads replica-lane tiers to pow2, and the lane axis must
+    split evenly across shards. On CPU the mesh is exercisable by
+    forcing virtual devices (:func:`force_cpu_devices`, honouring
+    ``--xla_force_host_platform_device_count``), which is how tier-1
+    and ``bench.py --fleet --mesh`` drive the whole plane without a
+    TPU claim."""
+    import jax
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if shards is None:
+        shards = mesh_shard_count(len(devices))
+    shards = int(shards)
+    if shards < 1 or shards & (shards - 1):
+        raise ValueError(f"mesh shard count must be a power of two: {shards}")
+    if shards > len(devices):
+        raise ValueError(
+            f"{shards} shards requested but only {len(devices)} device(s) "
+            f"detected (force more with force_cpu_devices on CPU)"
+        )
+    return Mesh(_np.array(devices[:shards]), ("replicas",))
+
+
 def enable_compilation_cache(path: str | None = None) -> str:
     """Point JAX's persistent compilation cache at a repo-local directory
     so repeated runs (benches, test sessions, a bench retry after a

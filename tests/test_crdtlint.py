@@ -3043,6 +3043,67 @@ def test_spmd001_cold_module_clean(tmp_path):
     assert [f for f in lint(pkg) if f.rule == "SPMD001"] == []
 
 
+def test_spmd001_mesh_twin_axis_free_reduction_flagged(tmp_path):
+    """ISSUE 13: the ``mesh_`` prefix joins the axis-function contract —
+    an axis-free reduction inside a mesh-lifted kernel folds only the
+    local shard and must be red."""
+    pkg = make_pkg(tmp_path, {"runtime/transition.py": """
+        import jax.numpy as jnp
+
+        def mesh_fleet_total(mesh, states):
+            return jnp.sum(states)
+    """})
+    found = [f for f in lint(pkg) if f.rule == "SPMD001"]
+    assert len(found) == 1 and "axis-free reduction" in found[0].message
+
+
+def test_spmd001_mesh_twin_axis_branch_flagged(tmp_path):
+    pkg = make_pkg(tmp_path, {"runtime/transition.py": """
+        def mesh_fleet_step(mesh, states):
+            if states.key.shape[0] > 4:
+                return states
+            return states
+    """})
+    found = [f for f in lint(pkg) if f.rule == "SPMD001"]
+    assert len(found) == 1 and "shard" in found[0].message
+
+
+def test_spmd001_mesh_rotate_shape_clean(tmp_path):
+    """The delivery-plane rotate shape is green: the permutation is
+    built from the mesh's static size (no branch), and the per-column
+    permute lives in a nested def (traces with its parent)."""
+    pkg = make_pkg(tmp_path, {"runtime/transition.py": """
+        import jax
+
+        def mesh_plane_rotate(mesh, shift, buffers):
+            n = mesh.devices.size
+            perm = [(i, (i + shift) % n) for i in range(n)]
+
+            def rotate(tree):
+                return jax.tree.map(
+                    lambda a: jax.lax.ppermute(a, "replicas", perm), tree
+                )
+
+            return rotate(buffers)
+    """})
+    assert [f for f in lint(pkg) if f.rule == "SPMD001"] == []
+
+
+def test_sync001_mesh_twin_is_jit_entry_root(tmp_path):
+    """ISSUE 13 satellite: the shard_map wrappers live in the
+    transition-contract module, so every mesh twin is a SYNC001 jit
+    entry root by contract — a host sync snuck into one is red without
+    any caller tracing it."""
+    pkg = make_pkg(tmp_path, {"runtime/transition.py": """
+        import numpy as np
+
+        def mesh_fleet_probe(mesh, states):
+            return np.asarray(states)
+    """})
+    found = [f for f in lint(pkg) if f.rule == "SYNC001"]
+    assert len(found) == 1 and "numpy array" in found[0].message
+
+
 # ----------------------------------------------------------------------
 # ISSUE 12 acceptance: the new families catch real-tree regressions
 # (engine overlay, working tree untouched)
@@ -3054,7 +3115,7 @@ def test_mutation_fleet_pad_deleted_is_caught():
     per occupancy."""
     rel = f"{PKG}/runtime/fleet.py"
     old = (
-        "        lanes = pow2_tier(n, floor=2)\n"
+        "        lanes = self._lane_tier(n)\n"
         "        sl, real_rows = stack_entry_slices"
     )
     assert old in (REPO_ROOT / rel).read_text()
@@ -3141,6 +3202,28 @@ def test_mutation_host_callback_in_transition_is_caught():
     new = _overlay_lint(rel, lambda s: s + inject)
     assert any(
         f.rule == "SPMD001" and "host callback" in f.message for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_mesh_kernel_axis_free_reduction_is_caught():
+    """ISSUE 13 acceptance: an axis-free reduction injected into the
+    REAL mesh-lifted merge twin turns the gate red (SPMD001) — under
+    shard_map it would fold only the local shard, a silent semantic
+    change the static gate must catch before any parity test runs."""
+    rel = f"{PKG}/runtime/transition.py"
+    old = "    return _lift(mesh, fleet_merge_rows)(states, slices)"
+    assert old in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(
+            old,
+            "    gate = states.key.sum()\n"
+            "    return _lift(mesh, fleet_merge_rows)(states, slices)",
+        ),
+    )
+    assert any(
+        f.rule == "SPMD001" and "axis-free reduction" in f.message
+        for f in new
     ), "\n".join(f.render() for f in new)
 
 

@@ -90,7 +90,7 @@ def test_jobs_parallel_matches_serial_on_red_tree():
     rel = f"{PKG}/runtime/fleet.py"
     src = (REPO_ROOT / rel).read_text()
     overlay = {rel: src.replace(
-        "        lanes = pow2_tier(n, floor=2)\n        sl, real_rows",
+        "        lanes = self._lane_tier(n)\n        sl, real_rows",
         "        lanes = n\n        sl, real_rows",
     )}
     serial = run_lint([REPO_ROOT / PKG], overlay=overlay)

@@ -1,0 +1,526 @@
+"""Mesh-sharded fleets (ISSUE 13): the shard_map twins and the
+intra-mesh delivery plane must be OBSERVABLY IDENTICAL to the vmap
+fleet — bit-for-bit state, WAL bytes, ack/protocol streams, and wire
+bytes — while the hot dispatches ride a replica-sharded device mesh
+and co-mesh sync-tick entries move as ppermute rotations instead of
+host sends.
+
+The conftest forces 8 virtual CPU devices
+(``--xla_force_host_platform_device_count``), so every shard count in
+{1, 2, 4, 8} is exercised in-process without TPU hardware — the same
+topology ``bench.py --fleet --mesh`` measures.
+
+Covers: mesh-vs-vmap kernel lane parity (both store backends),
+mesh-vs-vmap fleet parity on intra-mesh gossip (state, WAL bytes, seq,
+ack bookkeeping) and on off-mesh egress (wire streams + bytes, the TCP
+fallback path), mixed on/off-mesh destinations in one tick,
+shard-padding lanes (members ≶ shards), resident sharded-state
+placement + invalidation on fallback, and the mesh construction
+validation."""
+
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from delta_crdt_ex_tpu import AWLWWMap
+from delta_crdt_ex_tpu.api import start_link
+from delta_crdt_ex_tpu.runtime import sync as sync_proto, transition
+from delta_crdt_ex_tpu.runtime.clock import LogicalClock
+from delta_crdt_ex_tpu.runtime.fleet import Fleet
+from delta_crdt_ex_tpu.runtime.transport import LocalTransport
+from delta_crdt_ex_tpu.utils.devices import (
+    detected_topology,
+    fleet_mesh,
+    mesh_shard_count,
+)
+from tests.test_ingest_coalesce import (
+    _wal_segment_bytes,
+    keys_for_buckets,
+)
+
+
+def assert_state_bit_equal(s1, s2, ctx=""):
+    """Backend-agnostic bit comparison (the binned-column helper in
+    test_ingest_coalesce assumes BinnedStore fields)."""
+    l1, t1 = jax.tree.flatten(s1)
+    l2, t2 = jax.tree.flatten(s2)
+    assert t1 == t2, ctx
+    for a, b in zip(l1, l2):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), ctx
+
+
+def _mk(transport, store="binned", **kw):
+    kw.setdefault("capacity", 256)
+    kw.setdefault("tree_depth", 4)
+    # in-flight sync slots must not expire mid-test (see test_fleet.py)
+    kw.setdefault("sync_timeout", 600.0)
+    return start_link(
+        AWLWWMap, threaded=False, transport=transport, clock=LogicalClock(),
+        store=store, **kw,
+    )
+
+
+def _norm(msg):
+    """Address-free canonical form of one outbound sync message."""
+    if isinstance(msg, sync_proto.EntriesMsg):
+        return (
+            "entries",
+            np.asarray(msg.buckets).tolist(),
+            {c: np.asarray(v).tolist() for c, v in msg.arrays.items()},
+            sorted(map(repr, msg.payloads.items())),
+        )
+    if isinstance(msg, sync_proto.DiffMsg):
+        return (
+            "diff", msg.level, np.asarray(msg.idx).tolist(),
+            [np.asarray(b).tolist() for b in msg.blocks], msg.seq,
+            msg.log_horizon,
+        )
+    if isinstance(msg, sync_proto.AckMsg):
+        return ("ack",)
+    return (type(msg).__name__,)
+
+
+def _wire_bytes(msg):
+    """Pickled size of the address-free body — the wire-byte quantity."""
+    if isinstance(msg, sync_proto.EntriesMsg):
+        return len(pickle.dumps(
+            (np.asarray(msg.buckets),
+             {c: np.asarray(v) for c, v in msg.arrays.items()},
+             msg.payloads),
+            protocol=4,
+        ))
+    if isinstance(msg, sync_proto.DiffMsg):
+        return len(pickle.dumps(
+            (msg.level, msg.idx, msg.blocks, msg.seq, msg.log_horizon),
+            protocol=4,
+        ))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# mesh twin kernel parity: shard_map form == vmap form, bit-for-bit
+
+
+@pytest.mark.parametrize("shards", [1, 2, 4, 8])
+def test_mesh_merge_twin_matches_vmap(shards):
+    from tests.test_fleet import _mk_states_and_slices, _np_slice
+    from delta_crdt_ex_tpu.models.binned_map import stack_entry_slices
+
+    mesh = fleet_mesh(shards)
+    states, slices = _mk_states_and_slices(8, seed=shards)
+    stacked_sl, _ = stack_entry_slices([_np_slice(s) for s in slices])
+    stacked_st = transition.stack_states(states)
+    ref = transition.jit_fleet_merge_rows(stacked_st, stacked_sl)
+    got = transition.jit_mesh_fleet_merge_rows(mesh, stacked_st, stacked_sl)
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(got)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("store", ["binned", "hash"])
+def test_mesh_extraction_twins_match_vmap(store):
+    transport = LocalTransport()
+    n = 8
+    mesh = fleet_mesh(4)
+    reps = [
+        _mk(transport, store=store, name=f"mx{store}{i}", node_id=50 + i)
+        for i in range(n)
+    ]
+    for i, r in enumerate(reps):
+        for j in range(1 + 2 * i):  # ragged content: distinct dense tiers
+            r.mutate("add", [i * 100 + j, j])
+    model = reps[0].model
+    stacked = transition.stack_states([r.state for r in reps])
+    u = 16
+    rows = np.full((n, u), -1, np.int32)
+    lo = np.zeros((n, u), np.uint32)
+    for i, r in enumerate(reps):
+        own = np.asarray(r.state.ctx_max[:, r.self_slot])
+        pend = np.nonzero(own)[0][:u]
+        rows[i, : len(pend)] = pend
+    slots = np.asarray([r.self_slot for r in reps], np.int32)
+    gids = np.asarray([r.node_id for r in reps], np.uint64)
+
+    ref, ref_tiers = model.fleet_extract_own_delta(
+        stacked, jnp.asarray(rows), jnp.asarray(slots), jnp.asarray(gids),
+        jnp.asarray(lo),
+    )
+    got, got_tiers = model.mesh_fleet_extract_own_delta(
+        mesh, stacked, jnp.asarray(rows), jnp.asarray(slots),
+        jnp.asarray(gids), jnp.asarray(lo),
+    )
+    assert ref_tiers == got_tiers
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(got)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    ref2, t2 = model.fleet_extract_rows(stacked, jnp.asarray(rows))
+    got2, g2 = model.mesh_fleet_extract_rows(mesh, stacked, jnp.asarray(rows))
+    assert t2 == g2
+    for a, b in zip(jax.tree.leaves(ref2), jax.tree.leaves(got2)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_mesh_tree_and_ctr_twins_match_vmap():
+    mesh = fleet_mesh(4)
+    rng = np.random.default_rng(7)
+    leaves = jnp.asarray(
+        rng.integers(0, 2**32, size=(8, 16), dtype=np.uint32)
+    )
+    ref = transition.jit_fleet_tree_from_leaves(leaves)
+    got = transition.jit_mesh_fleet_tree_from_leaves(mesh, leaves)
+    assert len(ref) == len(got)
+    for a, b in zip(ref, got):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    cm = jnp.asarray(rng.integers(0, 1000, size=(8, 16, 8)).astype(np.uint32))
+    slots = jnp.asarray(np.arange(8, dtype=np.int32) % 8)
+    assert np.array_equal(
+        np.asarray(transition.jit_fleet_own_ctr_columns(cm, slots)),
+        np.asarray(transition.jit_mesh_fleet_own_ctr_columns(mesh, cm, slots)),
+    )
+
+
+def test_mesh_plane_rotate_moves_lanes_intact():
+    mesh = fleet_mesh(4)
+    rng = np.random.default_rng(11)
+    bufs = {
+        "a": rng.integers(0, 2**31, size=(4, 2, 3)).astype(np.int64),
+        "b": rng.integers(0, 2**32, size=(4, 2), dtype=np.uint64),
+    }
+    for shift in (1, 2, 3):
+        out = jax.device_get(
+            transition.jit_mesh_plane_rotate(
+                mesh, shift, jax.device_put(bufs, transition.replica_sharding(mesh))
+            )
+        )
+        for c, buf in bufs.items():
+            assert np.array_equal(out[c], np.roll(buf, shift, axis=0)), (c, shift)
+
+
+# ---------------------------------------------------------------------------
+# runtime parity: mesh fleet == vmap fleet, intra-mesh gossip
+
+
+def _drive_converged(fleet_a, fleet_b, members_a, members_b, rounds=6):
+    for _ in range(rounds):
+        fleet_a.sync_tick()
+        fleet_b.sync_tick()
+        fleet_a.drain()
+        fleet_b.drain()
+        for r in members_a + members_b:
+            r._outstanding.clear()
+            r._sync_open_seq.clear()
+
+
+@pytest.mark.parametrize("store", ["binned", "hash"])
+@pytest.mark.parametrize("shards", [2, 8])
+def test_mesh_vs_vmap_intra_gossip_bit_parity(store, shards, tmp_path):
+    """THE acceptance property: members gossiping among themselves —
+    every sync-tick entry crosses the mesh plane — end bit-identical to
+    the vmap fleet on state, seq, WAL segment bytes, and ack
+    bookkeeping, on both store backends and at shard counts below and
+    at the device count."""
+    transport = LocalTransport()
+    n = 4
+    mk = lambda tag, i: _mk(
+        transport, store=store, name=f"mg{store}{shards}{tag}{i}",
+        node_id=100 + i, wal_dir=str(tmp_path / f"{tag}{i}"),
+        fsync_mode="none",
+    )
+    fm = [mk("m", i) for i in range(n)]
+    vm = [mk("v", i) for i in range(n)]
+    for i in range(n):
+        fm[i].set_neighbours([fm[(i + 1) % n], fm[(i + 2) % n]])
+        vm[i].set_neighbours([vm[(i + 1) % n], vm[(i + 2) % n]])
+    f_mesh = Fleet(fm, mesh=fleet_mesh(shards))
+    f_vmap = Fleet(vm)
+
+    for rnd in range(3):
+        for i in range(n):
+            for j in range(2 + i):
+                k = rnd * 100 + i * 10 + j
+                fm[i].mutate("add", [k, k])
+                vm[i].mutate("add", [k, k])
+            if rnd == 1 and i % 2 == 0:
+                fm[i].mutate("remove", [100 + i * 10])
+                vm[i].mutate("remove", [100 + i * 10])
+        _drive_converged(f_mesh, f_vmap, fm, vm, rounds=1)
+    _drive_converged(f_mesh, f_vmap, fm, vm)
+
+    for i in range(n):
+        assert fm[i].read() == vm[i].read(), i
+        assert fm[i]._seq == vm[i]._seq, i
+        assert_state_bit_equal(fm[i].state, vm[i].state, (store, shards, i))
+        assert _wal_segment_bytes(fm[i]) == _wal_segment_bytes(vm[i]), i
+        assert len(fm[i]._outstanding) == len(vm[i]._outstanding), i
+    ms = f_mesh.stats()["mesh"]
+    assert ms["enabled"] and ms["shards"] == shards
+    assert ms["intra_entries"] > 0
+    assert ms["fallback_entries"] == 0  # every destination is co-mesh
+    if shards > 1:
+        assert ms["exchanges"] > 0 and ms["permuted_bytes"] > 0
+    # topology provenance: the PROBE_SHAPE field vocabulary
+    assert ms["topology"]["platform"] == "cpu"
+    assert ms["topology"]["global_devices"] >= shards
+    vs = f_vmap.stats()["mesh"]
+    assert not vs["enabled"] and vs["shards"] == 0
+
+
+@pytest.mark.parametrize("store", ["binned", "hash"])
+def test_mesh_off_mesh_fallback_stream_parity(store):
+    """Off-mesh destinations (receivers outside the fleet) take the
+    PR 10 collector path unchanged: the receivers' drained streams are
+    canonically identical and byte-for-byte equal in wire size to the
+    vmap fleet's — and the plane counts them as fallback entries."""
+    transport = LocalTransport()
+    n = 4
+    fm = [
+        _mk(transport, store=store, name=f"of{store}m{i}", node_id=100 + i)
+        for i in range(n)
+    ]
+    vm = [
+        _mk(transport, store=store, name=f"of{store}v{i}", node_id=100 + i)
+        for i in range(n)
+    ]
+    frecv = [
+        _mk(transport, store=store, name=f"of{store}mr{i}", node_id=900 + i)
+        for i in range(n)
+    ]
+    orecv = [
+        _mk(transport, store=store, name=f"of{store}vr{i}", node_id=900 + i)
+        for i in range(n)
+    ]
+    for i in range(n):
+        fm[i].set_neighbours([frecv[i]])
+        vm[i].set_neighbours([orecv[i]])
+    f_mesh = Fleet(fm, mesh=fleet_mesh(4))
+    f_vmap = Fleet(vm)
+    mesh_bytes = vmap_bytes = 0
+    for rnd in range(3):
+        for i in range(n):
+            for j in range(2 + i):
+                k = rnd * 1000 + i * 10 + j
+                fm[i].mutate("add", [k, k])
+                vm[i].mutate("add", [k, k])
+        f_mesh.sync_tick()
+        f_vmap.sync_tick()
+        for i in range(n):
+            a_msgs = transport.drain(frecv[i].addr)
+            b_msgs = transport.drain(orecv[i].addr)
+            assert len(a_msgs) == len(b_msgs) > 0, (rnd, i)
+            for a, b in zip(a_msgs, b_msgs):
+                assert _norm(a) == _norm(b), (rnd, i, type(a).__name__)
+                mesh_bytes += _wire_bytes(a)
+                vmap_bytes += _wire_bytes(b)
+            fm[i]._outstanding.clear()
+            fm[i]._sync_open_seq.clear()
+            vm[i]._outstanding.clear()
+            vm[i]._sync_open_seq.clear()
+    assert mesh_bytes == vmap_bytes > 0
+    ms = f_mesh.stats()["mesh"]
+    assert ms["fallback_entries"] > 0
+    assert ms["intra_entries"] == 0 and ms["exchanges"] == 0
+
+
+def test_mesh_mixed_destinations_one_tick():
+    """Members whose neighbour sets span the mesh AND an off-mesh
+    receiver in the SAME tick: co-mesh entries ride the exchange,
+    off-mesh ones the collector — and both receiver classes see exactly
+    the vmap twin's streams."""
+    transport = LocalTransport()
+    n = 4
+    fm = [_mk(transport, name=f"mixm{i}", node_id=100 + i) for i in range(n)]
+    vm = [_mk(transport, name=f"mixv{i}", node_id=100 + i) for i in range(n)]
+    frecv = [_mk(transport, name=f"mixmr{i}", node_id=900 + i) for i in range(n)]
+    orecv = [_mk(transport, name=f"mixvr{i}", node_id=900 + i) for i in range(n)]
+    for i in range(n):
+        # one co-fleet neighbour + one external receiver each
+        fm[i].set_neighbours([fm[(i + 1) % n], frecv[i]])
+        vm[i].set_neighbours([vm[(i + 1) % n], orecv[i]])
+    f_mesh = Fleet(fm, mesh=fleet_mesh(4))
+    f_vmap = Fleet(vm)
+    for i in range(n):
+        fm[i].mutate("add", [i, i * 11])
+        vm[i].mutate("add", [i, i * 11])
+    f_mesh.sync_tick()
+    f_vmap.sync_tick()
+    # external receivers: stream parity through the fallback path
+    for i in range(n):
+        a_msgs = transport.drain(frecv[i].addr)
+        b_msgs = transport.drain(orecv[i].addr)
+        assert len(a_msgs) == len(b_msgs) > 0, i
+        for a, b in zip(a_msgs, b_msgs):
+            assert _norm(a) == _norm(b), i
+    ms = f_mesh.stats()["mesh"]
+    assert ms["intra_entries"] > 0 and ms["fallback_entries"] > 0
+    # co-mesh deliveries land in member mailboxes: both fleets drain
+    # them into identical end states
+    f_mesh.drain()
+    f_vmap.drain()
+    for i in range(n):
+        assert fm[i].read() == vm[i].read(), i
+        assert_state_bit_equal(fm[i].state, vm[i].state, i)
+
+
+@pytest.mark.parametrize("n,shards", [(3, 8), (5, 4), (2, 2)])
+def test_mesh_shard_padding_lanes(n, shards):
+    """Member counts below/above/at the shard count: the lane tier pads
+    to a shard multiple (padding lanes merge nothing), occupancy counts
+    real members only, and parity holds."""
+    transport = LocalTransport()
+    fm = [_mk(transport, name=f"pad{n}{shards}m{i}", node_id=100 + i) for i in range(n)]
+    vm = [_mk(transport, name=f"pad{n}{shards}v{i}", node_id=100 + i) for i in range(n)]
+    for i in range(n):
+        fm[i].set_neighbours([fm[(i + 1) % n]])
+        vm[i].set_neighbours([vm[(i + 1) % n]])
+    f_mesh = Fleet(fm, mesh=fleet_mesh(shards))
+    f_vmap = Fleet(vm)
+    assert f_mesh._lane_tier(n) % shards == 0
+    assert f_mesh._lane_tier(n) >= max(n, shards)
+    for rnd in range(2):
+        for i in range(n):
+            fm[i].mutate("add", [rnd * 10 + i, i])
+            vm[i].mutate("add", [rnd * 10 + i, i])
+        _drive_converged(f_mesh, f_vmap, fm, vm, rounds=1)
+    _drive_converged(f_mesh, f_vmap, fm, vm)
+    for i in range(n):
+        assert fm[i].read() == vm[i].read(), (n, shards, i)
+        assert_state_bit_equal(fm[i].state, vm[i].state, (n, shards, i))
+
+
+def test_mesh_ingress_batches_and_resident_state_sharded():
+    """The ingress half rides the mesh twins too: a batched wave lands
+    in ONE sharded dispatch, and the resident stacked result stays
+    replica-sharded over the mesh between ticks."""
+    from tests.test_ingest_coalesce import entries_only
+
+    transport = LocalTransport()
+    clock = LogicalClock()
+    n = 4
+    mesh = fleet_mesh(4)
+    senders = [
+        start_link(
+            AWLWWMap, threaded=False, transport=transport, clock=clock,
+            capacity=256, tree_depth=4, name=f"ribs{i}", sync_timeout=600.0,
+        )
+        for i in range(n)
+    ]
+    members = [
+        _mk(transport, name=f"ribm{i}", node_id=100 + i) for i in range(n)
+    ]
+    for i, s in enumerate(senders):
+        s.set_neighbours([members[i]])
+    fleet = Fleet(members, mesh=mesh)
+    for rnd in range(2):
+        for i, s in enumerate(senders):
+            for k in keys_for_buckets(0, 16, 2, start=rnd * 37 + 7 * i):
+                s.mutate("add", [k, k])
+            s.sync_to_all()
+        for r in members:
+            entries_only(transport, r.addr)
+        fleet.drain()
+    st = fleet.stats()
+    assert st["dispatches"] >= 1
+    assert st["occupancy_hist"].get(n, 0) >= 1
+    # resident stacked state: cached and replica-sharded over the mesh
+    assert fleet._stack_cache, "no resident stacked state cached"
+    sharding = transition.replica_sharding(mesh)
+    for _versions, stacked in fleet._stack_cache.values():
+        leaf = jax.tree.leaves(stacked)[0]
+        assert leaf.sharding.is_equivalent_to(sharding, leaf.ndim)
+
+
+def test_mesh_resident_state_invalidated_on_fallback(tmp_path):
+    """A member escaping a sharded batched dispatch (bin-tier overflow
+    → solo growth path) must drop the bucket's resident sharded stack —
+    its lane in the result is stale — and end states still match the
+    vmap twin's."""
+    transport = LocalTransport()
+    n = 2
+    # per-replica clocks: the twin universes' ts streams must be
+    # identical, not interleaved through one shared counter
+    mk_member = lambda tag, i: start_link(
+        AWLWWMap, threaded=False, transport=transport, clock=LogicalClock(),
+        capacity=64, tree_depth=6, node_id=1000 + i, name=f"{tag}{i}",
+        sync_timeout=600.0,
+    )
+    mk_sender = lambda tag, i: start_link(
+        AWLWWMap, threaded=False, transport=transport, clock=LogicalClock(),
+        capacity=64, tree_depth=6, node_id=7000 + i, name=f"{tag}s{i}",
+        sync_timeout=600.0,
+    )
+    fsend = [mk_sender("mf", i) for i in range(n)]
+    vsend = [mk_sender("mv", i) for i in range(n)]
+    fm = [mk_member("mff", i) for i in range(n)]
+    vm = [mk_member("mvf", i) for i in range(n)]
+    for i in range(n):
+        fsend[i].set_neighbours([fm[i]])
+        vsend[i].set_neighbours([vm[i]])
+    f_mesh = Fleet(fm, mesh=fleet_mesh(2))
+    f_vmap = Fleet(vm)
+    # tiny bins (64 cap / 64 buckets → 4-slot bins): >4 same-bucket keys
+    # overflow a member's bin tier mid-batch → per-lane escape (the
+    # test_fleet growth-escape scenario, in mesh mode)
+    for k in keys_for_buckets(3, 4, 6, start=0):
+        fsend[0].mutate("add", [k, "x"])
+        vsend[0].mutate("add", [k, "x"])
+    for k in keys_for_buckets(40, 41, 5, start=50_000):
+        fsend[1].mutate("add", [k, "y"])
+        vsend[1].mutate("add", [k, "y"])
+    for s in fsend + vsend:
+        s.sync_to_all()
+    from tests.test_ingest_coalesce import entries_only
+
+    for r in fm + vm:
+        entries_only(transport, r.addr)
+    f_mesh.drain()
+    f_vmap.drain()
+    assert f_mesh.stats()["fallbacks"]["escape"] >= 1
+    # the escape dropped the resident sharded stack for that bucket
+    assert not f_mesh._stack_cache
+    for i in range(n):
+        assert fm[i].read() == vm[i].read(), i
+        assert_state_bit_equal(fm[i].state, vm[i].state, i)
+
+
+# ---------------------------------------------------------------------------
+# construction + validation
+
+
+def test_fleet_mesh_helpers():
+    assert mesh_shard_count(8) == 8
+    assert mesh_shard_count(6) == 4
+    assert mesh_shard_count(1) == 1
+    with pytest.raises(ValueError):
+        fleet_mesh(3)
+    with pytest.raises(ValueError):
+        fleet_mesh(1024)  # more shards than devices
+    mesh = fleet_mesh()
+    assert mesh.axis_names == ("replicas",)
+    assert mesh.devices.size == mesh_shard_count()
+    topo = detected_topology()
+    assert set(topo) == {
+        "platform", "global_devices", "local_devices", "processes"
+    }
+
+
+def test_fleet_rejects_bad_mesh():
+    import jax as _jax
+    from jax.sharding import Mesh
+
+    transport = LocalTransport()
+    rep = _mk(transport, name="badmesh0")
+    with pytest.raises(ValueError, match="replicas"):
+        Fleet([rep], mesh=Mesh(np.array(_jax.devices()[:2]), ("clients",)))
+
+
+def test_fleet_mesh_int_and_true_knobs():
+    transport = LocalTransport()
+    r1 = _mk(transport, name="knob0")
+    f = Fleet([r1], mesh=2)
+    assert f._mesh_shards == 2
+    r2 = _mk(transport, name="knob1")
+    f2 = Fleet([r2], mesh=True)
+    assert f2._mesh_shards == mesh_shard_count()

@@ -201,6 +201,51 @@ def test_jit_compile_counter_end_to_end(served):
     assert stanza["stats"]["compiles"]["merge_rows"] >= 1
 
 
+def test_mesh_gauges_scrape_and_unregister(transport):
+    """ISSUE 13 satellite: a mesh-mode fleet exports the ``crdt_mesh_*``
+    surface — polled shard-layout gauges plus the MESH_EXCHANGE bridge
+    counters — and ``unregister_fleet`` (via ``Fleet.stop``) removes the
+    gauges so a stopped fleet never scrapes as a stale last value."""
+    from delta_crdt_ex_tpu.runtime.fleet import Fleet
+    from delta_crdt_ex_tpu.utils.devices import fleet_mesh
+
+    plane = Observability()
+    try:
+        members = [
+            start_link(
+                threaded=False, transport=transport, obs=plane,
+                name=f"mobs{i}", node_id=400 + i, sync_timeout=600.0,
+            )
+            for i in range(2)
+        ]
+        for i in range(2):
+            members[i].set_neighbours([members[1 - i]])
+        fleet = Fleet(members, mesh=fleet_mesh(2), obs=plane)
+        members[0].mutate("add", ["k", "v"])
+        members[1].mutate("add", ["k2", "v2"])
+        fleet.sync_tick()
+        fleet.drain()
+        lb = f'fleet="{id(fleet)}"'
+        out = plane.registry.render()
+        assert f"crdt_mesh_shards{{{lb}}} 2" in out
+        assert f"crdt_mesh_members_per_shard{{{lb}}} 1" in out
+        # the bridge rows folded the tick's MESH_EXCHANGE event
+        m = re.search(
+            rf'crdt_mesh_intra_entries_total\{{{lb}\}} (\d+)', out
+        )
+        assert m and int(m.group(1)) >= 1, out[:2000]
+        assert f"crdt_mesh_fallback_entries_total{{{lb}}} 0" in out
+        assert re.search(
+            rf'crdt_mesh_permuted_bytes_total\{{{lb}\}} (\d+)', out
+        )
+        fleet.stop()
+        out = plane.registry.render()
+        assert f"crdt_mesh_shards{{{lb}}}" not in out
+        assert f"crdt_mesh_members_per_shard{{{lb}}}" not in out
+    finally:
+        plane.close()
+
+
 def test_jit_compile_collector_unregistered_on_close(transport):
     """A closed plane must stop running the compile-cache audit and
     drop its varz source — the unregister-cleanup contract every other

@@ -55,7 +55,9 @@ RULE_SHAPE = "SHAPE001"
 RULE_STATIC = "SHAPE002"
 
 #: modules whose jit-dispatch argument construction is SHAPE001-checked
-_SHELL_LEAVES = {"replica", "fleet", "binned_map", "hash_store", "transition"}
+_SHELL_LEAVES = {
+    "replica", "fleet", "binned_map", "hash_store", "transition", "meshplane",
+}
 
 #: tier/pad sanitiser seeds (import-resolved; aliases like ``_pow2``
 #: follow the import table). Any call to one of these sanitises its
@@ -288,7 +290,7 @@ def _is_jit_dispatch(node: ast.Call) -> bool:
     parts = chain.split(".")
     if len(parts) >= 2 and parts[-2] == "jit":
         return True
-    if leaf.startswith("fleet_") and len(parts) >= 2:
+    if leaf.startswith(("fleet_", "mesh_fleet_")) and len(parts) >= 2:
         return True
     return False
 

@@ -47,8 +47,11 @@ _DEBUG_SUFFIXES = ("debug.print", "debug.callback", "debug.breakpoint")
 _REDUCTION_LEAVES = {
     "sum", "max", "min", "mean", "prod", "any", "all", "argmax", "argmin",
 }
-#: functions with a leading replica axis by contract (the mesh seam)
-_AXIS_FN_PREFIXES = ("fleet_", "stack_")
+#: functions with a leading replica axis by contract (the mesh seam;
+#: ``mesh_`` covers the shard_map twins AND the delivery-plane rotate —
+#: ISSUE 13 lifted the kernels, so the lifted forms themselves must
+#: stay free of the constructs that would re-break them)
+_AXIS_FN_PREFIXES = ("fleet_", "stack_", "mesh_")
 
 
 def _is_transition_module(mod: ModuleInfo) -> bool:
