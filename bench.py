@@ -134,6 +134,17 @@ def _stage(name):
 _T_START = time.perf_counter()
 
 
+
+def _topology() -> dict:
+    """Detected device/mesh shape for artifact self-description
+    (ISSUE 14 satellite: EVERY bench artifact carries it, so
+    chip-window reruns are distinguishable from CPU evidence by field,
+    not filename)."""
+    from delta_crdt_ex_tpu.utils.devices import detected_topology
+
+    return detected_topology()
+
+
 def _jit_steady_gate(tag: str, roots: tuple, before: dict, after: dict) -> dict:
     """ISSUE 12 in-run gate: ZERO steady-state XLA compiles after warmup
     on the named dispatch roots — the measured rounds must ride a warm
@@ -630,6 +641,7 @@ def bench_durability():
         "preload_keys": preload,
         "waves": waves,
         "batch": batch,
+        "topology": _topology(),
     })
 
 
@@ -782,6 +794,7 @@ def bench_ingest():
         "tree_depth": depth,
         "max_coalesce": max_coalesce,
         "backend": "cpu",
+        "topology": _topology(),
     })
 
 
@@ -1076,6 +1089,7 @@ def bench_catchup():
         "max_sync_size": max_sync,
         "link_latency_s_per_hop": LAT,
         "backend": "cpu",
+        "topology": _topology(),
     })
 
 
@@ -2093,7 +2107,613 @@ def bench_hashstore():
         },
         "parity": "reads+leaf+ctx+seq (symmetric) and wal_bytes+acks (shared writer), asserted in-run",
         "backend": "cpu",
+        "topology": _topology(),
     })
+
+
+# ---------------------------------------------------------------------------
+# serving plane (ISSUE 14: bench.py --serve)
+
+
+def _serve_distinct_bucket_batches(n_batches: int, batch: int, depth: int,
+                                   tag: int) -> list:
+    """Batches of ``batch`` integer keys whose buckets are pairwise
+    DISTINCT within each batch — the deterministic-tier admission
+    workload: every grouped commit of one batch lands on exactly the
+    (u=pow2(batch), m=1) ``row_apply`` tier, so the steady-state
+    compile gate measures shape discipline, not key-collision luck."""
+    from delta_crdt_ex_tpu.utils.hashing import key_hash64_batch
+
+    n_buckets = 1 << depth
+    out = []
+    cand = tag << 40  # distinct key universe per tag
+    for _ in range(n_batches):
+        seen: set = set()
+        keys: list = []
+        while len(keys) < batch:
+            chunk = list(range(cand, cand + (1 << 14)))
+            cand += 1 << 14
+            hs = np.asarray(key_hash64_batch(chunk), np.uint64)
+            for k, b in zip(chunk, (hs & np.uint64(n_buckets - 1)).tolist()):
+                if b not in seen:
+                    seen.add(b)
+                    keys.append(k)
+                    if len(keys) == batch:
+                        break
+        out.append(keys)
+    return out
+
+
+def _serve_warm_tiers(rep, commit: int, depth: int) -> None:
+    """Pre-compile every ``row_apply``/read tier the serving legs can
+    hit: admission windows vary in size with client timing, and a
+    fresh (u, m) tier mid-measurement costs a multi-hundred-ms XLA
+    compile that snowballs the admission backlog (measured: write p50
+    went seconds without this). One throwaway replica of the same
+    geometry warms the process-wide cache for every leg."""
+    sizes = []
+    u = 1
+    while u <= commit:
+        sizes.append(u)
+        u *= 2
+    batches = _serve_distinct_bucket_batches(len(sizes), commit, depth, tag=9)
+    for size, batch in zip(sizes, batches):
+        rep.apply_ops([("add", [int(k), 0]) for k in batch[:size]])
+    # m tiers: one key duplicated m times inside a full distinct-bucket
+    # batch (u stays at the top tier, max-per-bucket count is exactly m)
+    for m, batch in zip(
+        (2, 4, 8, 16), _serve_distinct_bucket_batches(4, commit, depth, 10)
+    ):
+        ops = [("add", [int(k), 0]) for k in batch[: commit - (m - 1)]]
+        ops += [("add", [int(batch[0]), j]) for j in range(m - 1)]
+        rep.apply_ops(ops)
+    # bulk-read tiers (pow4 wire tiers for 4-key and 64-key reads)
+    rep.read_keys([int(batches[0][0]), int(batches[0][1])])
+    rep.read_keys([int(k) for k in batches[0]][:64])
+
+
+def _serve_percentiles(samples: list) -> dict:
+    a = np.asarray(samples, np.float64)
+    return {
+        "n": int(a.size),
+        "p50_ms": round(float(np.percentile(a, 50)) * 1e3, 3),
+        "p99_ms": round(float(np.percentile(a, 99)) * 1e3, 3),
+        "max_ms": round(float(a.max()) * 1e3, 3),
+    }
+
+
+def _serve_harness(tiny: bool = False) -> dict:
+    """The ``--serve`` open-loop load harness (ISSUE 14). Legs:
+
+    A. grouped admission vs the per-op ``mutate`` loop at N concurrent
+       clients (the aggregate-write-throughput headline; ≥3x gated in
+       full mode);
+    B. lock-free read proof: snapshot reads complete while the replica
+       lock is HELD (the structural no-replica-lock claim);
+    C. bit-for-bit parity vs an unloaded twin: the loaded front door's
+       committed op groups replay through the same ``apply_ops``
+       entrance on a twin — state bits, WAL bytes and seq must match;
+    D. open-loop mixed read/mutate traffic against a FLEET at fixed
+       arrival rates (Poisson arrivals, latency measured from the
+       SCHEDULED arrival — coordinated omission cannot flatter the
+       tail), p50/p99 per op class gated;
+    E. overload spike: admission sheds explicitly, ``/healthz`` flips
+       503 over live HTTP and recovers with the queue;
+    F. zero steady-state compiles on the admission/read dispatch roots
+       over a deterministic-tier drain round (full mode).
+
+    ``tiny=True`` is the tier-1 smoke shape (seconds): it gates the
+    parity assert and the /healthz overload flip; the throughput ratio
+    and latency numbers are reported, not gated."""
+    import dataclasses as _dc
+    import itertools
+    import shutil
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from delta_crdt_ex_tpu.api import start_fleet, start_link
+    from delta_crdt_ex_tpu.runtime.clock import LogicalClock
+    from delta_crdt_ex_tpu.runtime.metrics import Observability
+    from delta_crdt_ex_tpu.runtime.serve import Overloaded
+    from delta_crdt_ex_tpu.runtime.transport import LocalTransport
+    from delta_crdt_ex_tpu.utils import jitcache
+
+    depth = 8 if tiny else 10
+    cap = (1 << depth) * (32 if tiny else 128)
+    clients = 8 if tiny else 64
+    per_client = 25 if tiny else 150
+    commit = 64 if tiny else 256
+    # arrival rates are calibrated per run against the box's measured
+    # closed-loop capacity (shared CI hosts swing 2x run to run — a
+    # fixed rate either undershoots or collapses): the LOW rate (30%)
+    # is the gated regime, the HIGH rate (70%) is reported. The
+    # beyond-capacity behaviour is leg E's story: admission SHEDS
+    # instead of queueing.
+    rate_fracs = (0.3,) if tiny else (0.3, 0.7)
+    duration = 0.8 if tiny else 2.5
+    rng = np.random.default_rng(7)
+    res: dict = {"tiny": tiny, "clients": clients, "commit_ops": commit}
+
+    transport = LocalTransport()
+    mk = lambda name, **kw: start_link(
+        threaded=False, transport=transport, name=name, capacity=cap,
+        tree_depth=depth, **kw,
+    )
+    _stage("serve: warming admission/read kernel tiers")
+    warm_rep = mk("serve_warm")
+    _serve_warm_tiers(warm_rep, commit, depth)
+    warm_rep.stop()
+
+    # ---- leg A: grouped admission vs per-op mutate ---------------------
+    _stage("serve leg A: grouped admission vs per-op mutate")
+    rep_po = mk("serve_perop")
+    rep_gr = mk("serve_group")
+    fd = rep_gr.frontdoor(max_commit_ops=commit, max_pending_ops=1 << 30)
+    pools = [
+        rng.integers(1, 1 << 62, size=per_client, dtype=np.uint64).tolist()
+        for _ in range(clients)
+    ]
+
+    def flood(target, pools_):
+        threads = [
+            threading.Thread(target=lambda p=p: [target(int(k)) for k in p])
+            for p in pools_
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0
+
+    # warmup flood (jit tiers for both entrances), then the measured one
+    warm_pools = [
+        rng.integers(1, 1 << 62, size=max(per_client // 4, 4),
+                     dtype=np.uint64).tolist()
+        for _ in range(clients)
+    ]
+    flood(lambda k: rep_po.mutate("add", [k, k]), warm_pools)
+    flood(lambda k: fd.mutate("add", [k, k]), warm_pools)
+    dt_po = flood(lambda k: rep_po.mutate("add", [k, k]), pools)
+    dt_gr = flood(lambda k: fd.mutate("add", [k, k]), pools)
+    n_ops = clients * per_client
+    perop_rate, grouped_rate = n_ops / dt_po, n_ops / dt_gr
+    speedup = grouped_rate / perop_rate
+    st = fd.stats()
+    log(
+        f"serve admission: grouped {grouped_rate:.0f} vs per-op "
+        f"{perop_rate:.0f} ops/sec ({speedup:.2f}x; ops/commit "
+        f"{st['ops_per_commit']})"
+    )
+    res["admission"] = {
+        "clients": clients,
+        "ops": n_ops,
+        "grouped_ops_per_sec": round(grouped_rate, 1),
+        "per_op_ops_per_sec": round(perop_rate, 1),
+        "speedup": round(speedup, 3),
+        "ops_per_commit": st["ops_per_commit"],
+        "commit_depth_hist": {
+            str(k): v for k, v in st["commit_depth_hist"].items()
+        },
+    }
+    if not tiny:
+        assert speedup >= 3.0, (
+            f"grouped admission speedup {speedup:.2f} < 3.0 gate"
+        )
+        assert st["ops_per_commit"] > 2.0, st
+
+    # ---- leg B: reads are replica-lock-free ----------------------------
+    _stage("serve leg B: lock-held snapshot reads")
+    probe_keys = [int(pools[0][0]), int(pools[1][0])]
+    fd.read_keys(probe_keys)  # warm the read tier
+    rep_gr._lock.acquire()
+    try:
+        got: list = []
+
+        def reader():
+            for _ in range(20):
+                got.append(len(fd.read_keys(probe_keys)))
+
+        t = threading.Thread(target=reader)
+        t.start()
+        t.join(timeout=30)
+        assert not t.is_alive() and len(got) == 20, (
+            "snapshot reads blocked on the held replica lock"
+        )
+    finally:
+        rep_gr._lock.release()
+    res["lock_free_reads"] = {"reads_while_lock_held": 20}
+    rep_po.stop()
+    rep_gr.stop()
+
+    # ---- leg C: bit-for-bit parity vs the unloaded twin ----------------
+    _stage("serve leg C: loaded-vs-twin parity")
+    root = tempfile.mkdtemp(prefix="servebench_")
+    try:
+        a = mk(
+            "serve_par_a", node_id=4242, clock=LogicalClock(),
+            wal_dir=os.path.join(root, "a"), fsync_mode="none",
+        )
+        fda = a.frontdoor(max_commit_ops=commit, max_pending_ops=1 << 30,
+                          journal=True)
+        par_pools = [
+            rng.integers(1, 1 << 62, size=per_client, dtype=np.uint64).tolist()
+            for _ in range(max(clients // 2, 2))
+        ]
+        flood(lambda k: fda.mutate("add", [k, k]), par_pools)
+        fda.close()
+        journal = fda.journal()
+        b = mk(
+            "serve_par_b", node_id=4242, clock=LogicalClock(),
+            wal_dir=os.path.join(root, "b"), fsync_mode="none",
+        )
+        for group in journal:
+            b.apply_ops(group)
+        for c in (f.name for f in _dc.fields(a.model.Store)):
+            va, vb = getattr(a.state, c), getattr(b.state, c)
+            assert np.array_equal(np.asarray(va), np.asarray(vb)), (
+                f"loaded/twin state diverged: {c}"
+            )
+        assert a._seq == b._seq, (a._seq, b._seq)
+
+        def wal_bytes(rep):
+            segs = sorted(
+                os.path.join(rep._wal.directory, p)
+                for p in os.listdir(rep._wal.directory)
+            )
+            return b"".join(open(s, "rb").read() for s in segs)
+
+        wa, wb = wal_bytes(a), wal_bytes(b)
+        assert wa == wb, (len(wa), len(wb))
+        log(
+            f"serve parity: state bit-identical, WAL {len(wa)} bytes "
+            f"identical across {len(journal)} committed groups"
+        )
+        res["parity"] = {
+            "groups": len(journal),
+            "ops": sum(len(g) for g in journal),
+            "wal_bytes": len(wa),
+            "result": "bit_for_bit_state_and_wal",
+        }
+        a.stop()
+        b.stop()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    # ---- leg D: open-loop mixed traffic against a fleet ----------------
+    _stage("serve leg D: open-loop fleet load")
+    n_members = 2 if tiny else 4
+    fleet = start_fleet(
+        n_members, threaded=True,
+        names=[f"serve_f{i}" for i in range(n_members)],
+        capacity=cap, tree_depth=depth, sync_interval=0.25,
+        sync_timeout=600.0,
+    )
+    # ring topology: gossip stays live under load without the full-mesh
+    # fan-out saturating the shared fleet thread (which would starve
+    # the admission workers of the member locks — measured: full mesh
+    # at 50 ms intervals put write p50 at seconds)
+    for i, rep in enumerate(fleet.replicas):
+        rep.set_neighbours([fleet.replicas[(i + 1) % n_members]])
+    ffd = fleet.frontdoor(max_commit_ops=commit, max_pending_ops=1 << 30)
+    read_pool = [f"olr{j}" for j in range(64)]
+    for j, k in enumerate(read_pool):
+        ffd.mutate("add", [k, j])
+    # warm every member's read tier and multi-op commit tiers (the load
+    # phase must measure serving, not first-touch XLA compiles)
+    ffd.read_keys(read_pool)
+    warm_tickets = [
+        ffd.mutate_async("add", [f"olw{j}", j]) for j in range(8 * commit)
+    ]
+    for tks in warm_tickets:
+        for tk in tks:
+            tk.result(120)
+    workers = 6 if tiny else 16
+
+    # closed-loop capacity calibration: the same 70/30 mix issued
+    # back-to-back by the same worker pool — the box's serveable rate
+    # this run, which the open-loop arrival schedule is sized against
+    cal_end = time.perf_counter() + (0.5 if tiny else 1.0)
+    cal_counts = [0] * workers
+
+    def calibrate(idx):
+        i = 0
+        while time.perf_counter() < cal_end:
+            if i % 10 < 7:
+                ffd.read_keys([read_pool[(idx * 7 + i) % 64]])
+            else:
+                ffd.mutate("add", [f"cal{idx}/{i}", i], timeout=60)
+            cal_counts[idx] += 1
+            i += 1
+
+    cal_threads = [
+        threading.Thread(target=calibrate, args=(i,)) for i in range(workers)
+    ]
+    t_cal = time.perf_counter()
+    for t in cal_threads:
+        t.start()
+    for t in cal_threads:
+        t.join()
+    capacity = sum(cal_counts) / (time.perf_counter() - t_cal)
+    log(f"serve open-loop: calibrated capacity {capacity:.0f} mixed ops/sec")
+    rates = [max(50, int(capacity * f)) for f in rate_fracs]
+    res["open_loop"] = {
+        "members": n_members,
+        "calibrated_capacity_ops_per_sec": round(capacity, 1),
+        "rates": {},
+    }
+    # phase list: one UNMEASURED soak at the top rate first — the
+    # gossip path's wire-tier kernels (delta extraction, tree builds)
+    # compile on first touch at load-dependent row tiers, and those
+    # one-off several-hundred-ms stalls must land in warmup, not in a
+    # measured p99 (the round-0 discipline every bench here follows)
+    phases = [(rate_fracs[-1], rates[-1], False)] + [
+        (f, r, True) for f, r in zip(rate_fracs, rates)
+    ]
+    for frac, rate, measured in phases:
+        n = int(rate * duration)
+        offs = np.cumsum(rng.exponential(1.0 / rate, size=n))
+        kinds = rng.random(n) < 0.7  # 70% reads / 30% writes
+        sched = [
+            (
+                float(offs[i]),
+                "read" if kinds[i] else "write",
+                (
+                    [read_pool[j] for j in rng.integers(0, 64, 4)]
+                    if kinds[i]
+                    else [f"ol{rate}/{i}", i]
+                ),
+            )
+            for i in range(n)
+        ]
+        counter = itertools.count()
+        lat_read: list = []
+        write_pending: list = []
+        t0 = time.perf_counter() + 0.05
+
+        def issue():
+            while True:
+                i = next(counter)
+                if i >= n:
+                    return
+                t_arr, kind, payload = sched[i]
+                now = time.perf_counter()
+                if now < t0 + t_arr:
+                    time.sleep(t0 + t_arr - now)
+                if kind == "read":
+                    ffd.read_keys(payload)
+                    lat_read.append(time.perf_counter() - (t0 + t_arr))
+                else:
+                    tks = ffd.mutate_async("add", payload)
+                    write_pending.append((tks, t0 + t_arr))
+
+        threads = [threading.Thread(target=issue) for _ in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=duration * 20 + 60)
+        lat_write: list = []
+        for tks, t_arr in write_pending:
+            for tk in tks:
+                tk.result(60)
+            lat_write.append(max(tk.t_done for tk in tks) - t_arr)
+        t_end = time.perf_counter()
+        achieved = n / (t_end - t0)
+        entry = {
+            "capacity_fraction": frac,
+            "target_ops_per_sec": rate,
+            "achieved_ops_per_sec": round(achieved, 1),
+            "read": _serve_percentiles(lat_read),
+            "write": _serve_percentiles(lat_write),
+        }
+        if not measured:
+            log(f"serve open-loop soak @{rate}/s done (unmeasured warmup)")
+            continue
+        res["open_loop"]["rates"][str(rate)] = entry
+        log(
+            f"serve open-loop @{rate}/s ({int(frac * 100)}% cap): achieved "
+            f"{achieved:.0f}/s, read "
+            f"p50/p99 {entry['read']['p50_ms']}/{entry['read']['p99_ms']} ms, "
+            f"write p50/p99 {entry['write']['p50_ms']}/{entry['write']['p99_ms']} ms"
+        )
+        if not tiny and frac <= 0.5:
+            # the gated regime (30% of this run's measured capacity):
+            # open-loop arrival clocks mean queueing delay COUNTS, so
+            # these tails are honest; the 70% leg is reported unguarded
+            # (co-tenant noise at high utilisation is not our signal)
+            assert entry["read"]["p99_ms"] <= 500.0, entry
+            assert entry["write"]["p99_ms"] <= 2500.0, entry
+            assert achieved >= 0.7 * rate, entry
+    fleet.stop()
+
+    # ---- leg E: overload spike, /healthz flip + recovery ---------------
+    _stage("serve leg E: overload shed + healthz flip")
+    plane = Observability()
+    rep_ovl = start_link(
+        threaded=False, transport=LocalTransport(), name="serve_ovl",
+        capacity=cap, tree_depth=depth, obs=plane,
+    )
+    fd_ovl = rep_ovl.frontdoor(
+        max_pending_ops=32, max_commit_ops=32, shed_health_hold=2.0,
+    )
+    for i in range(16):
+        fd_ovl.mutate("add", [f"warm{i}", i])  # warm the commit tiers
+    server = plane.serve(port=0)
+
+    def healthz() -> int:
+        try:
+            with urllib.request.urlopen(server.url + "/healthz", timeout=15) as r:
+                return r.status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    assert healthz() == 200
+    shed = [0]
+
+    # the rate spike: concurrent clients submit far faster than the
+    # admission worker can commit, the 32-op window fills, and the
+    # excess sheds; the sticky shed_health_hold keeps the overload
+    # observable on /healthz until the queue has drained AND the spike
+    # stopped (then it recovers)
+    def spike(i):
+        for j in range(400):
+            try:
+                fd_ovl.mutate_async("add", [f"spike{i}/{j}", j])
+            except Overloaded:
+                shed[0] += 1
+
+    threads = [threading.Thread(target=spike, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    code_during = healthz()
+    assert shed[0] > 0, "spike never shed"
+    assert code_during == 503, f"/healthz served {code_during} under overload"
+    deadline = time.monotonic() + 30
+    code_after = 0
+    while time.monotonic() < deadline:
+        code_after = healthz()
+        if code_after == 200:
+            break
+        time.sleep(0.05)
+    assert code_after == 200, "/healthz never recovered after the spike"
+    sst = fd_ovl.stats()
+    log(
+        f"serve overload: shed {shed[0]} ops "
+        f"({sst['shed_by_reason']}), healthz 200 -> 503 -> 200"
+    )
+    res["overload"] = {
+        "spike_ops": 4 * 400,
+        "shed_ops": shed[0],
+        "shed_by_reason": sst["shed_by_reason"],
+        "healthz_under_overload": code_during,
+        "healthz_recovered": code_after,
+    }
+    rep_ovl.stop()
+    plane.close()
+
+    # ---- leg F: zero steady-state compiles on the admission roots ------
+    if not tiny:
+        _stage("serve leg F: steady-state compile gate")
+        rep_g = start_link(
+            threaded=False, transport=LocalTransport(), name="serve_jit",
+            capacity=cap, tree_depth=depth,
+        )
+        fdg = rep_g.frontdoor(max_commit_ops=commit, max_pending_ops=1 << 30)
+        n_batches = 8
+        rounds = [
+            _serve_distinct_bucket_batches(n_batches, commit, depth, tag)
+            for tag in (1, 2)
+        ]
+        probe = [int(rounds[0][0][0]), int(rounds[0][0][1])]
+
+        sentinel = itertools.count(1 << 50)
+
+        def drain_round(batches, with_reads):
+            # preload whole full-size commits while the worker is
+            # blocked on the replica lock: every grouped commit then
+            # lands on exactly one (u, m=1) row_apply tier. A sentinel
+            # op parks the worker INSIDE apply_ops (on the held lock)
+            # first, so it cannot pop a partial prefix mid-preload.
+            rep_g._lock.acquire()
+            try:
+                s = next(sentinel)
+                fdg.mutate_async("add", [s, s])
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    with fdg._lock:
+                        parked = not fdg._queue and fdg._pending_ops == 1
+                    if parked:
+                        break
+                    time.sleep(0.001)
+                tickets = [
+                    fdg.mutate_async("add", [int(k), int(k)])
+                    for batch in batches
+                    for k in batch
+                ]
+            finally:
+                rep_g._lock.release()
+            stop = threading.Event()
+
+            def read_loop():
+                while not stop.is_set():
+                    fdg.read_keys(probe)
+
+            rt = threading.Thread(target=read_loop)
+            if with_reads:
+                rt.start()
+            t0 = time.perf_counter()
+            for tk in tickets:
+                tk.result(120)
+            dt = time.perf_counter() - t0
+            if with_reads:
+                stop.set()
+                rt.join(timeout=10)
+            return len(tickets) / dt
+
+        fdg.read_keys(probe)  # warm the read tier
+        drain_round(rounds[0], with_reads=True)  # warm round
+        pre_jit = jitcache.compile_counts()
+        gate_rate = drain_round(rounds[1], with_reads=True)
+        jit_counts = _jit_steady_gate(
+            "serve",
+            ("row_apply", "winners_for_keys"),
+            pre_jit, jitcache.compile_counts(),
+        )
+        log(
+            f"serve jit gate: zero steady-state compiles, drain "
+            f"{gate_rate:.0f} ops/sec at {commit}-op commits"
+        )
+        res["jit"] = {
+            "steady_state": "zero_compiles_in_gated_round",
+            "drain_ops_per_sec": round(gate_rate, 1),
+            "compiles": jit_counts,
+        }
+        rep_g.stop()
+
+    res["gates"] = {
+        "admission_speedup_min": None if tiny else 3.0,
+        "parity": "bit_for_bit_state_and_wal",
+        "healthz_flip": "503_under_overload_then_200",
+        "read_p99_ms_max": None if tiny else 1000.0,
+        "jit_steady_state": None if tiny else "zero_compiles",
+    }
+    return res
+
+
+def bench_serve():
+    """``--serve``: the heavy-traffic serving-plane harness (ISSUE 14).
+    Open-loop (fixed arrival rates), p50/p99 gated, grouped-admission
+    speedup gated >=3x at 64 clients, shed/healthz flip/recovery and
+    bit-for-bit loaded-vs-twin parity asserted in-run. Host-bound
+    admission amortisation is the measured effect, so this runs
+    wherever invoked (no device claim dance). Artifact:
+    ``benchmarks/results/serve_cpu_<date>.json``."""
+    import datetime
+
+    res = _serve_harness(tiny=SMOKE)
+    artifact = {
+        "metric": "serve_admission_write_speedup" + ("_smoke" if SMOKE else ""),
+        "unit": "x (grouped admission / per-op mutate aggregate ops/sec)",
+        "stat": f"one_flood_of_{res['clients']}_clients",
+        "value": res["admission"]["speedup"],
+        **res,
+        "backend": "cpu",
+        "topology": _topology(),
+        "utc": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+    }
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks", "results",
+        f"serve_cpu_{datetime.date.today().strftime('%Y%m%d')}.json",
+    )
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    log(f"serve artifact written to {out_path}")
+    _emit(artifact)
 
 
 # ---------------------------------------------------------------------------
@@ -2463,6 +3083,7 @@ def bench_obs():
             "wire_findings": 0,
         },
         "backend": "cpu",
+        "topology": _topology(),
         "utc": datetime.datetime.now(datetime.timezone.utc).isoformat(),
     }
     out_path = os.path.join(
@@ -2741,6 +3362,9 @@ def main():
         return
     if "--obs" in sys.argv:
         bench_obs()
+        return
+    if "--serve" in sys.argv:
+        bench_serve()
         return
     if "--tpu-child" in sys.argv:
         # SIGTERM → clean Python unwind (finalizers run, the device

@@ -44,13 +44,17 @@ __all__ = [
     "HashAWSet",
     "FileStorage",
     "Fleet",
+    "FleetFrontdoor",
+    "Frontdoor",
     "MemoryStorage",
     "Observability",
     "ObsServer",
+    "Overloaded",
     "Replica",
     "Storage",
     "WalLog",
     "child_spec",
+    "frontdoor",
     "mutate",
     "mutate_async",
     "mutate_batch",
@@ -72,14 +76,18 @@ _EXPORTS = {
     "HashAWLWWMap": ("delta_crdt_ex_tpu.models.hash_store", "HashAWLWWMap"),
     "HashAWSet": ("delta_crdt_ex_tpu.models.hash_store", "HashAWSet"),
     "Fleet": ("delta_crdt_ex_tpu.runtime.fleet", "Fleet"),
+    "FleetFrontdoor": ("delta_crdt_ex_tpu.runtime.serve", "FleetFrontdoor"),
+    "Frontdoor": ("delta_crdt_ex_tpu.runtime.serve", "Frontdoor"),
     "MemoryStorage": ("delta_crdt_ex_tpu.runtime.storage", "MemoryStorage"),
     "Observability": ("delta_crdt_ex_tpu.runtime.metrics", "Observability"),
     "ObsServer": ("delta_crdt_ex_tpu.runtime.obs_server", "ObsServer"),
+    "Overloaded": ("delta_crdt_ex_tpu.runtime.serve", "Overloaded"),
     "FileStorage": ("delta_crdt_ex_tpu.runtime.storage", "FileStorage"),
     "Replica": ("delta_crdt_ex_tpu.runtime.replica", "Replica"),
     "Storage": ("delta_crdt_ex_tpu.runtime.storage", "Storage"),
     "WalLog": ("delta_crdt_ex_tpu.runtime.wal", "WalLog"),
     "child_spec": ("delta_crdt_ex_tpu.api", "child_spec"),
+    "frontdoor": ("delta_crdt_ex_tpu.api", "frontdoor"),
     "mutate": ("delta_crdt_ex_tpu.api", "mutate"),
     "mutate_async": ("delta_crdt_ex_tpu.api", "mutate_async"),
     "mutate_batch": ("delta_crdt_ex_tpu.api", "mutate_batch"),

@@ -306,3 +306,38 @@ def mutate_batch(
 def read(crdt: Replica, timeout: float = DEFAULT_TIMEOUT) -> "dict[Any, Any] | set":
     """Resolved read: a dict for map models, a set for ``AWSet``."""
     return crdt.read(timeout)
+
+
+def frontdoor(crdt, **opts):
+    """The serving front door of a replica or fleet (ISSUE 14) —
+    created on first use and cached on the target.
+
+    The front door is the client-facing hot path for heavy traffic:
+
+    - **lock-free snapshot reads** — ``fd.read_keys(keys)`` /
+      ``fd.read()`` / ``fd.scan(prefix)`` run off an immutable
+      published store generation without EVER taking the replica lock,
+      so reads never queue behind the event loop's merges.
+      ``Replica.read(timeout)`` keeps its flush-then-read semantics as
+      the strong-read mode — serving is additive (MIGRATING.md).
+    - **coalesced write admission** — ``fd.mutate(f, args)`` /
+      ``fd.mutate_async(f, args)`` fold many concurrent clients' ops
+      into one grouped commit per admission window through the SAME
+      ``Replica.apply_ops`` entrance ``mutate_batch`` uses: N client
+      ops cost one vectorised kernel dispatch + one WAL group commit
+      instead of N lock/notify round-trips.
+    - **backpressure** — past the admission-queue / mailbox /
+      transport ``queue_bytes`` / WAL-backlog limits, ops are shed
+      with an explicit :class:`~delta_crdt_ex_tpu.runtime.serve.
+      Overloaded`; shedding flips the plane's ``/healthz`` check to
+      503 and the ``crdt_serve_*`` metrics family records
+      admitted/shed/coalesce-depth/latency.
+
+    ``crdt`` may be a :class:`Replica` (→ :class:`~delta_crdt_ex_tpu.
+    runtime.serve.Frontdoor`) or a :class:`~delta_crdt_ex_tpu.runtime.
+    fleet.Fleet` (→ one front door per member with key-hash routing).
+    Options (``max_commit_ops``, ``max_pending_ops``,
+    ``max_mailbox_depth``, ``max_queue_bytes``, ``max_wal_backlog``,
+    ``shed_health_hold``, ``journal``) are fixed at first creation.
+    Gated by ``bench.py --serve`` (open-loop p50/p99 harness)."""
+    return crdt.frontdoor(**opts)

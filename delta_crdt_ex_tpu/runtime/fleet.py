@@ -355,6 +355,8 @@ class Fleet:
         #: varz/health sources + a scrape-time collector for occupancy /
         #: fill-ratio / tick gauges; members register themselves
         self._obs = metrics_mod.resolve_obs(obs)
+        #: the fleet's cached FleetFrontdoor (ISSUE 14, ``frontdoor()``)
+        self._frontdoor = None
         if self._obs is not None:
             self._obs.register_fleet(self)
 
@@ -1020,6 +1022,13 @@ class Fleet:
         in the solo topology surviving members' own loops merge a
         stopping peer's final push — here the fleet is that loop, so it
         must serve the push before the recipients close their WALs."""
+        with self._lock:
+            fd, self._frontdoor = self._frontdoor, None
+        if fd is not None:
+            # close the serving plane first: its admission workers must
+            # not race member shutdown (outside the fleet lock — close
+            # joins threads)
+            fd.close()
         if self._thread is not None:
             self._stop.set()
             self._wake.set()
@@ -1030,6 +1039,27 @@ class Fleet:
         for rep in self.replicas:
             rep.stop()
             self.drain()  # surviving members process the goodbye sync
+
+    # ------------------------------------------------------------------
+    # serving plane (ISSUE 14)
+
+    def frontdoor(self, **opts):
+        """The fleet's serving front door, created on first use and
+        cached: one :class:`~delta_crdt_ex_tpu.runtime.serve.Frontdoor`
+        per member plus key-hash routing — see
+        :class:`~delta_crdt_ex_tpu.runtime.serve.FleetFrontdoor`.
+        Closed automatically by :meth:`stop`."""
+        from delta_crdt_ex_tpu.runtime.serve import FleetFrontdoor
+
+        with self._lock:
+            if self._frontdoor is None:
+                self._frontdoor = FleetFrontdoor(self, **opts)
+            elif opts:
+                raise ValueError(
+                    "fleet front door already exists; options are fixed "
+                    "at first creation"
+                )
+            return self._frontdoor
 
     # ------------------------------------------------------------------
     # observability (ISSUE 6 satellite)

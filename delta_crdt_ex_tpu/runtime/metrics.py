@@ -44,7 +44,8 @@ Metric naming scheme: every name is ``crdt_<noun>[_<unit>]`` with the
 Prometheus conventions — ``_total`` counters, ``_seconds`` / ``_bytes``
 units, histograms exported as ``_bucket``/``_sum``/``_count``. Label
 keys are drawn from the closed set ``name`` (replica), ``peer``,
-``origin``, ``plane``, ``role``, ``fleet``, ``transport``.
+``origin``, ``plane``, ``role``, ``fleet``, ``transport``, ``reason``
+(shed signal), ``mode`` (read class).
 
 Lock order (deadlock-free by construction, LOCK002): replica lock →
 tracer/recorder lock → registry lock. Nothing here ever acquires a
@@ -587,6 +588,40 @@ class MetricsBridge:
             "Bytes moved by intra-mesh ppermute rotations (padded buffers)",
             ("fleet",),
         )
+        # serving plane (ISSUE 14): admission/shed/read accounting —
+        # the front door's client-facing counterpart of the ingest
+        # coalescing family (one SERVE_ADMIT per grouped commit, one
+        # SERVE_SHED per rejected op, one SERVE_READ per snapshot read)
+        self.serve_commits = c(
+            "crdt_serve_commits_total", "Admission grouped commits", ("name",)
+        )
+        self.serve_admitted = c(
+            "crdt_serve_admitted_ops_total",
+            "Client write ops admitted and committed", ("name",),
+        )
+        self.serve_depth = h(
+            "crdt_serve_coalesce_depth",
+            "Client ops folded per admission commit", ("name",),
+            buckets=COUNT_BUCKETS,
+        )
+        self.serve_commit_seconds = h(
+            "crdt_serve_commit_seconds",
+            "Admission group-commit wall time", ("name",),
+        )
+        self.serve_shed = c(
+            "crdt_serve_shed_ops_total",
+            "Client write ops shed by backpressure", ("name", "reason"),
+        )
+        self.serve_reads = c(
+            "crdt_serve_reads_total", "Snapshot reads served", ("name", "mode")
+        )
+        self.serve_read_seconds = h(
+            "crdt_serve_read_seconds", "Snapshot read wall time", ("name",)
+        )
+        self.serve_read_retries = c(
+            "crdt_serve_read_retries_total",
+            "Stale-snapshot read retries", ("name",),
+        )
         # monotone by construction (a tracing cache only grows), hence
         # the _total name despite the set-to-absolute gauge primitive:
         # the jitcache audit reports absolute per-root compile counts,
@@ -629,6 +664,9 @@ class MetricsBridge:
             (telemetry.FLEET_EGRESS, self._on_fleet_egress),
             (telemetry.MESH_EXCHANGE, self._on_mesh_exchange),
             (telemetry.JIT_COMPILE, self._on_jit_compile),
+            (telemetry.SERVE_ADMIT, self._on_serve_admit),
+            (telemetry.SERVE_SHED, self._on_serve_shed),
+            (telemetry.SERVE_READ, self._on_serve_read),
         ]
 
     def attach(self) -> "MetricsBridge":
@@ -792,6 +830,31 @@ class MetricsBridge:
         lb = (self._s(meta.get("name")),)
         with self._lock:
             self.jit_compiles._set_held(lb, meas.get("compiles", 0))
+
+    def _on_serve_admit(self, _event, meas, meta) -> None:
+        lb = (self._s(meta.get("name")),)
+        g = meas.get
+        with self._lock:
+            self.serve_commits._inc_held(lb)
+            self.serve_admitted._inc_held(lb, g("ops", 0))
+            self.serve_depth._observe_held(lb, g("ops", 0))
+            self.serve_commit_seconds._observe_held(lb, g("duration_s", 0.0))
+
+    def _on_serve_shed(self, _event, meas, meta) -> None:
+        lb = (self._s(meta.get("name")), self._s(meta.get("reason", "")))
+        with self._lock:
+            self.serve_shed._inc_held(lb, meas.get("ops", 1))
+
+    def _on_serve_read(self, _event, meas, meta) -> None:
+        name = self._s(meta.get("name"))
+        lb = (name, self._s(meta.get("mode", "keys")))
+        g = meas.get
+        with self._lock:
+            self.serve_reads._inc_held(lb, g("reads", 1))
+            self.serve_read_seconds._observe_held((name,), g("duration_s", 0.0))
+            retries = g("retries", 0)
+            if retries:
+                self.serve_read_retries._inc_held((name,), retries)
 
 
 # ----------------------------------------------------------------------
@@ -1075,6 +1138,16 @@ class Observability:
             "crdt_fleet_egress_bucket_occupancy",
             "Mean members per batched egress extraction bucket", ("fleet",),
         )
+        self._g_serve_pending = g(
+            "crdt_serve_pending_ops",
+            "Write ops queued or in flight in the serving front door",
+            ("name",),
+        )
+        self._g_serve_overloaded = g(
+            "crdt_serve_overloaded",
+            "1 while the serving front door is shedding (0 healthy)",
+            ("name",),
+        )
         self._g_mesh_shards = g(
             "crdt_mesh_shards",
             "Mesh shard count of a mesh-mode fleet (0 = vmap mode)",
@@ -1186,12 +1259,56 @@ class Observability:
         if collect is not None:
             self.registry.unregister_collector(collect)
             rep._obs_collector = None
+        # a still-attached front door unwires with its replica: its
+        # collector would otherwise keep re-setting the serve gauges
+        # removed below (the crdt_serve_* cleanup contract, ISSUE 14)
+        fd = getattr(rep, "_frontdoor", None)
+        if fd is not None:
+            self.unregister_serve(fd)
         for gauge in (
             self._g_mailbox, self._g_seq, self._g_payloads,
             self._g_outstanding, self._g_wal_segments, self._g_wal_bytes,
             self._g_wal_horizon,
         ):
+            # serve gauges are NOT in this loop: unregister_serve (the
+            # register_serve pair, invoked above and by Frontdoor.close)
+            # owns their cleanup unambiguously
             gauge.remove((rep.name,))
+
+    # -- serving plane (ISSUE 14) ----------------------------------------
+
+    def register_serve(self, fd) -> None:
+        """Wire one serving front door into the plane: ``serve:{name}``
+        varz + health sources (the health check is what flips
+        ``/healthz`` to 503 while the plane sheds) plus a scrape-time
+        collector polling the pending/overloaded gauges."""
+        key = f"serve:{fd.name}"
+        self.add_varz_source(key, fd.obs_varz)
+        self.add_health_check(key, fd.health)
+        name_lb = (fd.name if type(fd.name) is str else str(fd.name),)
+
+        def collect() -> None:
+            st = fd.stats()
+            self._g_serve_pending.set(st["pending_ops"], name_lb)
+            self._g_serve_overloaded.set(
+                1.0 if st["overloaded"] else 0.0, name_lb
+            )
+
+        fd._obs_collector = collect
+        self.registry.register_collector(collect)
+
+    def unregister_serve(self, fd) -> None:
+        """Unwire a front door (close / replica teardown): sources,
+        collector and gauges all go — a closed plane must not scrape
+        as a stale last value (the unregister-cleanup contract)."""
+        self.remove_source(f"serve:{fd.name}")
+        collect = getattr(fd, "_obs_collector", None)
+        if collect is not None:
+            self.registry.unregister_collector(collect)
+            fd._obs_collector = None
+        name_lb = (fd.name if type(fd.name) is str else str(fd.name),)
+        self._g_serve_pending.remove(name_lb)
+        self._g_serve_overloaded.remove(name_lb)
 
     def register_fleet(self, fleet) -> None:
         key = f"fleet:{id(fleet):x}"
@@ -1222,6 +1339,12 @@ class Observability:
         if collect is not None:
             self.registry.unregister_collector(collect)
             fleet._obs_collector = None
+        # fleet front door: per-member serve gauges unwire with the
+        # fleet (each member Frontdoor registered under its own name)
+        fd = getattr(fleet, "_frontdoor", None)
+        if fd is not None:
+            for member_fd in fd.members:
+                self.unregister_serve(member_fd)
         for gauge in (
             self._g_fleet_occupancy, self._g_fleet_fill, self._g_fleet_ticks,
             self._g_fleet_egress_mpf, self._g_fleet_egress_fpt,

@@ -323,6 +323,20 @@ class Replica:
         self._state: Any = None
         self._fleet_src: "tuple | None" = None
         self._state_version = 0
+        #: serving-plane read publication (ISSUE 14): the immutable
+        #: ``(version, state, fleet_src, payloads)`` triple the front
+        #: door's lock-free snapshot reads pin. Swapped ATOMICALLY
+        #: (one attribute store) by ``_publish_serve`` at commit
+        #: boundaries — points where the device state and the host
+        #: payload dict agree — and read by ``runtime/serve.py``
+        #: WITHOUT the replica lock. The payload dict referenced by a
+        #: publication is append-only for its generation's lifetime
+        #: (``gc`` REPLACES the dict, never prunes it in place), so a
+        #: pinned snapshot keeps resolving its winners forever.
+        self._serve_pub: "tuple | None" = None
+        #: the replica's cached Frontdoor (``frontdoor()``); closed on
+        #: stop/crash so the admission worker never outlives the replica
+        self._frontdoor = None
         #: fleet participation counters (stats()["fleet"], mirroring
         #: the ingress coalescing surface): batched dispatches this
         #: replica rode, messages merged in them, and solo fallbacks
@@ -938,12 +952,27 @@ class Replica:
         this beats a ``mutate_async`` loop by the per-op lock/notify
         overhead on top of it. No reference analog (``mutate/4`` is
         per-op, ``delta_crdt.ex:117-120``); semantics are identical to
-        issuing the ops in order."""
-        self._acquire(timeout, f"mutate_batch {f!r}")
+        issuing the ops in order. Delegates to :meth:`apply_ops` — THE
+        one grouped-commit implementation, shared with the serving
+        plane's write admission (ISSUE 14: two batched write entrances
+        must not drift; parity is pinned in ``tests/test_serve.py``)."""
+        self.apply_ops([(f, args) for args in items], timeout)
+
+    def apply_ops(self, ops: list, timeout: float | None = None) -> None:
+        """THE grouped-commit entrance: apply ``ops`` — ``(f, args)``
+        pairs, possibly mixed kinds — in order as ONE batch under one
+        lock acquisition and one flush (one vectorised kernel pass per
+        clear-free segment, one WAL group commit for batches within
+        ``MAX_BATCH``). Both batched write entrances route through
+        here: ``mutate_batch`` (bulk loads) and the serving plane's
+        admission worker (``runtime/serve.py``), so WAL record bytes
+        and state bits are bit-for-bit identical for identical op
+        sequences regardless of the entrance."""
+        self._acquire(timeout, "apply_ops")
         try:
             pre = len(self._pending)
             try:
-                for args in items:
+                for f, args in ops:
                     self._enqueue(f, args)
             except Exception:
                 # a rejected batch must not partially commit later: drop
@@ -1315,6 +1344,9 @@ class Replica:
                 self._state = self.model.grow_for_apply(st)
                 self._fleet_src = None
                 self._state_version += 1
+                # growth preserves content but swaps the store pytree:
+                # republish so readers pin the live generation
+                self._publish_serve()
                 self._grown_telemetry(self._state)
 
     def _grown_telemetry(self, state) -> None:
@@ -1428,6 +1460,10 @@ class Replica:
         if not keep_read_cache:
             self._read_cache = None
             self._read_cache_kh = None
+        # commit boundary: device state and host payload dict agree here
+        # (payloads are registered before every path that reaches this),
+        # so the serving plane's lock-free readers may pin it
+        self._publish_serve()
         if telemetry.has_handlers(telemetry.SYNC_DONE):
             name = self.name
 
@@ -2729,6 +2765,10 @@ class Replica:
         readbacks when one is active). Caller holds the lock, has
         stored the merged state, and has invalidated the tree/read
         caches."""
+        # commit boundary for the grouped paths (solo grouped + fleet
+        # batched): state stored, payloads registered — publish for the
+        # serving plane's lock-free readers
+        self._publish_serve()
         depth = len(msgs)
         want_done = telemetry.has_handlers(telemetry.SYNC_DONE)
         want_round = telemetry.has_handlers(telemetry.SYNC_ROUND)
@@ -2885,6 +2925,54 @@ class Replica:
             self._maybe_gc()
             return committed_version
 
+    # -- serving plane (ISSUE 14) ----------------------------------------
+
+    def _publish_serve(self) -> None:
+        """Publish the current commit for the serving plane's lock-free
+        snapshot readers (caller holds the lock, at a commit boundary:
+        every alive dot of the current state has its payload in
+        ``_payloads``). One tuple build + one atomic attribute store —
+        the entire hot-path cost of read publication."""
+        self._serve_pub = (
+            self._state_version, self._state, self._fleet_src, self._payloads,
+        )
+
+    def publish_read_snapshot(self) -> tuple:
+        """Force a publication of the current state (the serving
+        plane's priming/refresh hook — e.g. before the first read, or
+        after a stale-read race) and return the published triple."""
+        with self._lock:
+            self._publish_serve()
+            return self._serve_pub
+
+    def frontdoor(self, **opts):
+        """This replica's serving front door (ISSUE 14), created on
+        first use and cached: lock-free snapshot reads, coalesced write
+        admission, backpressure/shedding — see
+        :class:`delta_crdt_ex_tpu.runtime.serve.Frontdoor`. Closed
+        automatically on :meth:`stop`/:meth:`crash`."""
+        from delta_crdt_ex_tpu.runtime.serve import Frontdoor
+
+        with self._lock:
+            if self._frontdoor is None:
+                self._frontdoor = Frontdoor(self, **opts)
+            elif opts:
+                raise ValueError(
+                    f"front door for {self.name!r} already exists; options "
+                    "are fixed at first creation"
+                )
+            return self._frontdoor
+
+    def _close_frontdoor(self) -> None:
+        """Detach and close the cached front door (stop/crash teardown).
+        The close itself — which joins the admission worker — runs
+        OUTSIDE the replica lock (LOCK003: never join a thread that may
+        be blocked on the lock we hold)."""
+        with self._lock:
+            fd, self._frontdoor = self._frontdoor, None
+        if fd is not None:
+            fd.close()
+
     def _merge_with_growth(self, sl):
         # row-granular merge: runtime slices are ≤ max_sync_size rows,
         # where whole-row math costs the same as element scatters but
@@ -2952,6 +3040,11 @@ class Replica:
             self._key_terms = {h: t for h, t in self._key_terms.items() if h in keep_keys}
             self._gc_pressure = 0
             self._gc_floor = len(self._payloads)
+            # republish with the pruned dict (same version, same state:
+            # every published winner is a live dot, so all survive the
+            # prune) — without this, the serving plane's pinned triple
+            # keeps the pre-gc dict alive until the next commit
+            self._publish_serve()
 
     def _maybe_gc(self) -> None:
         """Called (under the lock) after payload-inserting paths.
@@ -3248,6 +3341,7 @@ class Replica:
         ``storage_mode`` already persisted, and deregistration fires
         ``Down`` at monitoring peers. A later ``start_link`` with the
         same name + storage rehydrates with node-id continuity."""
+        self._close_frontdoor()
         if self._thread is not None:
             self._stop.set()
             self._wake.set()
@@ -3275,6 +3369,7 @@ class Replica:
         """Terminate: best-effort final sync (reference ``terminate/2``,
         ``causal_crdt.ex:200-204``), then deregister (fires Down at
         monitoring peers)."""
+        self._close_frontdoor()
         if self._thread is not None:
             self._stop.set()
             self._wake.set()

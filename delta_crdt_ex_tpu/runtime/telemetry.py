@@ -26,6 +26,9 @@ FLEET_DISPATCH = ("delta_crdt", "fleet", "dispatch")  # measurements: replicas, 
 FLEET_EGRESS = ("delta_crdt", "fleet", "egress")  # measurements: members, jobs_batched, jobs_solo, dispatches, frames, frame_members, duration_s; metadata: fleet
 MESH_EXCHANGE = ("delta_crdt", "mesh", "exchange")  # measurements: intra_entries, fallback_entries, permuted_bytes, exchanges, shards; metadata: fleet
 JIT_COMPILE = ("delta_crdt", "jit", "compile")  # measurements: compiles (absolute tracing-cache size); metadata: name (jit entry root)
+SERVE_ADMIT = ("delta_crdt", "serve", "admit")  # measurements: ops, duration_s; metadata: name
+SERVE_SHED = ("delta_crdt", "serve", "shed")  # measurements: ops; metadata: name, reason
+SERVE_READ = ("delta_crdt", "serve", "read")  # measurements: reads, retries, duration_s; metadata: name, mode ("keys"|"full"|"scan")
 
 def declared_events() -> tuple[tuple, ...]:
     """Every event tuple this module declares (the OBS001 contract:

@@ -2690,6 +2690,55 @@ def test_mutation_declared_unemitted_event_is_caught():
     assert any("bridge" in m for m in msgs)
 
 
+def test_mutation_unguarded_serve_emission_is_caught():
+    """ISSUE 14 acceptance: ``runtime/serve.py`` is an OBS002 hot-path
+    module — stripping the has_handlers guard off the REAL shed
+    emission turns the gate red."""
+    rel = f"{PKG}/runtime/serve.py"
+    guard = "if telemetry.has_handlers(telemetry.SERVE_SHED):"
+    assert guard in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(rel, lambda s: s.replace(guard, "if True:", 1))
+    assert any(
+        f.rule == "OBS002" and "SERVE_SHED" in f.message for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_dropped_serve_bridge_row_is_caught():
+    """ISSUE 14 acceptance: deleting the SERVE_SHED subscription row
+    from the REAL metrics bridge turns the gate red (OBS001)."""
+    rel = f"{PKG}/runtime/metrics.py"
+    row = "            (telemetry.SERVE_SHED, self._on_serve_shed),\n"
+    assert row in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(rel, lambda s: s.replace(row, ""))
+    assert any(
+        f.rule == "OBS001" and "SERVE_SHED" in f.message for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_unlocked_serve_shed_counter_read_is_caught():
+    """ISSUE 14 acceptance: the serving front door sits in the
+    LOCK/RACE thread graph (its admission worker is a discovered
+    thread entry, its one lock mints guards) — an injected UNLOCKED
+    read of the shed counter in the REAL ``runtime/serve.py`` turns
+    the gate red."""
+    rel = f"{PKG}/runtime/serve.py"
+    probe = (
+        "\n"
+        "    def shed_probe(self) -> int:\n"
+        "        return self._shed_ops\n"
+    )
+    anchor = "    def close(self) -> None:"
+    src = (REPO_ROOT / rel).read_text()
+    assert anchor in src
+    new = _overlay_lint(rel, lambda s: s.replace(anchor, probe + "\n" + anchor, 1))
+    assert any(
+        f.rule in ("LOCK001", "RACE001")
+        and "_shed_ops" in f.message
+        and "Frontdoor" in f.message
+        for f in new
+    ), "\n".join(f.render() for f in new)
+
+
 # ----------------------------------------------------------------------
 # SHAPE001/SHAPE002 — recompile discipline (ISSUE 12)
 

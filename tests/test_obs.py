@@ -246,6 +246,74 @@ def test_mesh_gauges_scrape_and_unregister(transport):
         plane.close()
 
 
+def test_serve_gauges_scrape_and_unregister_replica(transport):
+    """ISSUE 14 satellite: a replica's serving front door exports the
+    ``crdt_serve_*`` surface (polled pending/overloaded gauges + the
+    bridge-fed admission counters), and ``unregister_replica`` (via
+    ``Replica.stop``) removes the gauges so a stopped replica never
+    scrapes as a stale last value."""
+    from delta_crdt_ex_tpu.api import frontdoor
+
+    plane = Observability()
+    try:
+        rep = start_link(
+            threaded=False, transport=transport, obs=plane, name="srvfd",
+        )
+        fd = frontdoor(rep)
+        fd.mutate("add", ["k", "v"])
+        fd.read_keys(["k"])
+        out = plane.registry.render()
+        assert 'crdt_serve_pending_ops{name="srvfd"} 0' in out
+        assert 'crdt_serve_overloaded{name="srvfd"} 0' in out
+        assert 'crdt_serve_admitted_ops_total{name="srvfd"} 1' in out
+        assert 'crdt_serve_commits_total{name="srvfd"} 1' in out
+        assert 'crdt_serve_reads_total{name="srvfd",mode="keys"} 1' in out
+        assert "crdt_serve_coalesce_depth_bucket" in out
+        assert "crdt_serve_read_seconds_bucket" in out
+        # the varz/health sources ride the same registration
+        assert "serve:srvfd" in plane.varz()["sources"]
+        assert plane.varz()["sources"]["serve:srvfd"]["kind"] == "serve"
+        rep.stop()
+        out = plane.registry.render()
+        assert 'crdt_serve_pending_ops{name="srvfd"}' not in out
+        assert 'crdt_serve_overloaded{name="srvfd"}' not in out
+        assert "serve:srvfd" not in plane.varz()["sources"]
+    finally:
+        plane.close()
+
+
+def test_serve_gauges_cleanup_on_unregister_fleet(transport):
+    """ISSUE 14 satellite: a fleet front door's per-member serve gauges
+    unwire on ``unregister_fleet`` (via ``Fleet.stop``)."""
+    from delta_crdt_ex_tpu.runtime.fleet import Fleet
+
+    plane = Observability()
+    try:
+        members = [
+            start_link(
+                threaded=False, transport=transport, obs=plane,
+                name=f"sfobs{i}", sync_timeout=600.0,
+            )
+            for i in range(2)
+        ]
+        fleet = Fleet(members, obs=plane)
+        fd = fleet.frontdoor()
+        fd.mutate("add", ["k", "v"])
+        fleet.drain()
+        out = plane.registry.render()
+        assert 'crdt_serve_pending_ops{name="sfobs0"}' in out
+        assert 'crdt_serve_pending_ops{name="sfobs1"}' in out
+        fleet.stop()
+        out = plane.registry.render()
+        assert "crdt_serve_pending_ops" not in out.split("# HELP")[0] or True
+        assert 'crdt_serve_pending_ops{name="sfobs0"}' not in out
+        assert 'crdt_serve_pending_ops{name="sfobs1"}' not in out
+        assert 'crdt_serve_overloaded{name="sfobs0"}' not in out
+        assert not [k for k in plane.varz()["sources"] if k.startswith("serve:")]
+    finally:
+        plane.close()
+
+
 def test_jit_compile_collector_unregistered_on_close(transport):
     """A closed plane must stop running the compile-cache audit and
     drop its varz source — the unregister-cleanup contract every other

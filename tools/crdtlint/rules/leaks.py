@@ -53,7 +53,7 @@ from tools.crdtlint.rules import (
 RULE = "LEAK001"
 
 #: modules whose nested defs are checked (the drain/tick hot paths)
-_HOT_LEAVES = {"replica", "fleet"}
+_HOT_LEAVES = {"replica", "fleet", "serve"}
 
 #: call leaves returning kernel-result pytrees / new store generations
 _KERNEL_LEAVES = {

@@ -13,7 +13,7 @@ hold, and both silently rot without a gate:
   when events are declared but NO bridge table exists at all (a rename
   must not disarm the rule).
 - **OBS002** — a ``telemetry.execute`` call in a hot-path module
-  (replica / fleet / transports) not guarded by
+  (replica / fleet / serve / transports) not guarded by
   ``telemetry.has_handlers(...)``: with telemetry disabled the call
   still builds its measurement/metadata dicts (and often pays a device
   readback) on every merge. Guards may be inline
@@ -28,7 +28,7 @@ UPPERCASE module-level tuple-of-strings assignments in a module whose
 dotted name ends in ``telemetry``; the bridge table is any ``_table``
 function returning a list of ``(event, handler)`` tuples; hot modules
 are those whose last dotted part is ``replica`` / ``fleet`` /
-``transport`` / ``tcp_transport``.
+``serve`` / ``transport`` / ``tcp_transport``.
 """
 
 from __future__ import annotations
@@ -41,7 +41,10 @@ from tools.crdtlint.rules import call_leaf, iter_function_defs
 RULE_COVERAGE = "OBS001"
 RULE_GUARD = "OBS002"
 
-_HOT_LEAVES = {"replica", "fleet", "transport", "tcp_transport"}
+#: ``serve`` (ISSUE 14): the serving front door emits per-commit and
+#: per-read telemetry — the client hot path pays for unguarded dict
+#: builds exactly like the ingest path does
+_HOT_LEAVES = {"replica", "fleet", "serve", "transport", "tcp_transport"}
 
 
 def _telemetry_module(project: Project) -> ModuleInfo | None:

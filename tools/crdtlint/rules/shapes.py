@@ -57,6 +57,7 @@ RULE_STATIC = "SHAPE002"
 #: modules whose jit-dispatch argument construction is SHAPE001-checked
 _SHELL_LEAVES = {
     "replica", "fleet", "binned_map", "hash_store", "transition", "meshplane",
+    "serve",  # ISSUE 14: snapshot reads dispatch winners_for_keys directly
 }
 
 #: tier/pad sanitiser seeds (import-resolved; aliases like ``_pow2``
