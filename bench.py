@@ -799,6 +799,239 @@ def bench_ingest():
 
 
 # ---------------------------------------------------------------------------
+# hierarchical anti-entropy (ISSUE 15: reduction-tree gossip)
+
+def bench_tree():
+    """``--tree``: propagation rounds + bytes-on-wire, reduction-tree
+    gossip vs flat 64-neighbour gossip at 256 simulated peers.
+
+    Two isolated universes run the IDENTICAL probe workload: a
+    deepest-tier writer adds a fresh key, then global rounds tick (every
+    replica syncs once, messages deliver to quiescence — one round = one
+    sync interval of real time, intra-round delivery being the
+    network-latency ≪ sync-interval regime). Flat gossip covers the 64
+    direct neighbours in round 1 but transitive spread waits a round per
+    generation of digest walks; the tree's relays re-emit coalesced
+    merged slices at the end of every drain pass, so propagation
+    cascades through the whole tree within the writer's round. Gates
+    asserted IN-RUN: median propagation rounds ≥2× better than flat,
+    total bytes-on-wire ≥1.5× better, canonical end-state parity
+    bit-for-bit between every tree/flat replica pair, zero steady-state
+    compiles on the relay merge/extraction roots. Host-bound topology
+    effects: runs wherever invoked (no device claim dance). The flat
+    legs ride the same artifact so the ratios are self-contained."""
+    import pickle
+    import statistics
+
+    from delta_crdt_ex_tpu.api import start_link
+    from delta_crdt_ex_tpu.runtime.clock import LogicalClock
+    from delta_crdt_ex_tpu.runtime.transport import LocalTransport
+    from delta_crdt_ex_tpu.utils import jitcache
+
+    peers = 16 if SMOKE else 256
+    # the flat baseline must fan out to FEWER than half the peers or
+    # the per-replica coverage median degenerates to round 1 by
+    # construction (64-of-256 is the real topology's shape; the smoke
+    # scale keeps the same under-half proportion)
+    flat_neighbours = min(4 if SMOKE else 64, peers - 1)
+    fanout = 4 if SMOKE else 8
+    probes = 2 if SMOKE else 3
+    depth = 6
+    max_rounds = 12
+
+    class CountingTransport(LocalTransport):
+        """LocalTransport with wire-byte accounting: every delivered
+        message is costed at its pickled size (what a socket transport
+        would ship), the cross-universe comparable byte metric."""
+
+        def __init__(self):
+            super().__init__()
+            self.bytes = 0
+            self.msgs = 0
+
+        def send(self, addr, msg):
+            ok = super().send(addr, msg)
+            if ok:
+                self.bytes += len(pickle.dumps(msg, protocol=4))
+                self.msgs += 1
+            return ok
+
+    def build(tag, tree):
+        transport = CountingTransport()
+        clock = LogicalClock()
+        reps = [
+            start_link(
+                threaded=False, transport=transport, clock=clock,
+                name=f"{tag}{i}", node_id=i + 1, capacity=512,
+                # writer tables pre-sized for the whole membership:
+                # slice writer tables flood gid knowledge through the
+                # universe, and mid-probe R-tier growth would recompile
+                # the hot roots DURING the measured rounds (a real
+                # growth event, but not the steady state this gate
+                # measures — production fleets saturate gid knowledge
+                # in their first minutes)
+                replica_capacity=2 * peers,
+                tree_depth=depth, sync_timeout=600.0,
+                tree_gossip=tree, tree_fanout=fanout,
+            )
+            for i in range(peers)
+        ]
+        addrs = [r.addr for r in reps]
+        if tree:
+            for r in reps:
+                r.set_neighbours(addrs)
+        else:
+            # the flat baseline: 64 deterministic pseudo-random
+            # neighbours per replica (the seed's 64-neighbour topology)
+            rng = np.random.default_rng(7)
+            for i, r in enumerate(reps):
+                others = [a for j, a in enumerate(addrs) if j != i]
+                picks = rng.choice(len(others), flat_neighbours, replace=False)
+                r.set_neighbours([others[j] for j in sorted(picks)])
+        return transport, reps
+
+    def global_round(reps):
+        for r in reps:
+            r.sync_to_all()
+        for _ in range(2000):
+            if not sum(r.process_pending() for r in reps):
+                return
+        raise AssertionError("universe did not quiesce")
+
+    def run_probes(tag, transport, reps, writer_idx):
+        # settle membership/warmup traffic outside the measurement
+        for _ in range(2):
+            global_round(reps)
+        cover_rounds: list[int] = []  # pooled per-(probe, replica)
+        full_rounds: list[int] = []
+        probe_bytes: list[int] = []
+        probe_msgs: list[int] = []
+        pre_jit = {}
+        for p in range(probes):
+            if p == probes - 1 and tag == "tree":
+                # entering the LAST measured probe of the LAST universe:
+                # every steady-state shape must already be compiled
+                pre_jit = jitcache.compile_counts()
+            key = f"probe-{p}"
+            writer = reps[writer_idx]
+            writer.mutate("add", [key, p])
+            covered = {writer_idx}
+            b0, m0 = transport.bytes, transport.msgs
+            rnd = 0
+            while len(covered) < peers and rnd < max_rounds:
+                rnd += 1
+                global_round(reps)
+                for i, r in enumerate(reps):
+                    if i not in covered and r.read_keys([key]):
+                        covered.add(i)
+                        cover_rounds.append(rnd)
+            assert len(covered) == peers, (
+                f"{tag}: probe {p} never reached full coverage "
+                f"({len(covered)}/{peers} after {max_rounds} rounds)"
+            )
+            full_rounds.append(rnd)
+            probe_bytes.append(transport.bytes - b0)
+            probe_msgs.append(transport.msgs - m0)
+        return {
+            "median_propagation_rounds": statistics.median(cover_rounds),
+            "full_coverage_rounds": full_rounds,
+            "bytes_per_probe": probe_bytes,
+            "msgs_per_probe": probe_msgs,
+            "bytes_total": sum(probe_bytes),
+            "msgs_total": sum(probe_msgs),
+        }, pre_jit
+
+    _stage(f"tree-gossip: {peers} peers, fanout {fanout} vs flat "
+           f"{flat_neighbours}-neighbour")
+    flat_t, flat_reps = build("f", tree=False)
+    tree_t, tree_reps = build("t", tree=True)
+    topo = tree_reps[0]._tree_refresh()
+    # the honest worst case: the writer sits at the DEEPEST tier (same
+    # index writes in the flat universe)
+    writer_idx = max(
+        range(peers), key=lambda i: topo.tier.get(tree_reps[i].addr, 0)
+    )
+    flat_stats, _ = run_probes("flat", flat_t, flat_reps, writer_idx)
+    tree_stats, pre_jit = run_probes("tree", tree_t, tree_reps, writer_idx)
+
+    # ISSUE 12 gate: zero steady-state compiles on the relay merge /
+    # re-emission roots across the last measured probe
+    jit_counts = _jit_steady_gate(
+        "tree",
+        ("merge_rows", "extract_rows", "row_apply", "winners_for_keys"),
+        pre_jit, jitcache.compile_counts(),
+    )
+
+    # parity: both universes saw the same op stream — every replica
+    # pair must agree canonically, bit for bit
+    _stage("tree-gossip: canonical parity sweep")
+    for _ in range(3):  # belt-and-braces full convergence
+        global_round(flat_reps)
+        global_round(tree_reps)
+    want = tree_reps[0].canonical_state_bytes()
+    for i in range(peers):
+        ct = tree_reps[i].canonical_state_bytes()
+        cf = flat_reps[i].canonical_state_bytes()
+        assert ct == cf, f"tree/flat canonical state diverged at peer {i}"
+        assert ct == want, f"tree universe did not converge at peer {i}"
+
+    rounds_ratio = (
+        flat_stats["median_propagation_rounds"]
+        / tree_stats["median_propagation_rounds"]
+    )
+    bytes_ratio = flat_stats["bytes_total"] / tree_stats["bytes_total"]
+    msgs_ratio = flat_stats["msgs_total"] / tree_stats["msgs_total"]
+    assert rounds_ratio >= 2.0, (
+        f"median propagation rounds: tree must be >=2x better, got "
+        f"{rounds_ratio:.2f}x (flat "
+        f"{flat_stats['median_propagation_rounds']}, tree "
+        f"{tree_stats['median_propagation_rounds']})"
+    )
+    assert bytes_ratio >= 1.5, (
+        f"bytes-on-wire: tree must be >=1.5x better, got {bytes_ratio:.2f}x"
+    )
+
+    relay_stats = [
+        r.stats()["tree"] for r in tree_reps
+        if r.stats()["tree"]["reemits"]
+    ]
+    folds = sum(s["msgs_folded"] for s in relay_stats)
+    reemits = sum(s["reemits"] for s in relay_stats)
+    log(
+        f"tree: rounds {tree_stats['median_propagation_rounds']} vs flat "
+        f"{flat_stats['median_propagation_rounds']} ({rounds_ratio:.1f}x), "
+        f"bytes {tree_stats['bytes_total']} vs {flat_stats['bytes_total']} "
+        f"({bytes_ratio:.1f}x), msgs ratio {msgs_ratio:.1f}x, "
+        f"{reemits} re-emissions folding {folds} inbound frames"
+    )
+    _emit({
+        "metric": "tree_gossip_propagation" + ("_smoke" if SMOKE else ""),
+        "unit": "x_better_than_flat",
+        "stat": f"median_over_{probes}_probes",
+        "value": round(rounds_ratio, 3),
+        "rounds_ratio": round(rounds_ratio, 3),
+        "bytes_ratio": round(bytes_ratio, 3),
+        "msgs_ratio": round(msgs_ratio, 3),
+        "peers": peers,
+        "tree_fanout": fanout,
+        "tree_depth": topo.depth,
+        "tree_root": str(topo.root),
+        "writer_tier": int(topo.tier.get(tree_reps[writer_idx].addr, 0)),
+        "flat_neighbours": flat_neighbours,
+        "tree": tree_stats,
+        "flat": flat_stats,
+        "relay_reemits": reemits,
+        "relay_msgs_folded": folds,
+        "relay_folds_per_reemit": round(folds / reemits, 3) if reemits else 0.0,
+        "parity": "bit_for_bit_canonical_state_checked_all_pairs",
+        "jit_compiles": jit_counts,
+        "jit_steady_state": "zero_compiles_in_last_probe",
+        "backend": "cpu",
+        "topology": _topology(),
+    })
+
+
+# ---------------------------------------------------------------------------
 # log-shipping catch-up (ISSUE 4: serve WAL ranges instead of walking)
 
 def bench_catchup():
@@ -3343,6 +3576,9 @@ def main():
         return
     if "--catchup" in sys.argv:
         bench_catchup()
+        return
+    if "--tree" in sys.argv:
+        bench_tree()
         return
     if "--fleet" in sys.argv:
         if "--mesh" in sys.argv:

@@ -147,6 +147,28 @@ def start_link(
     (engage the clamped stream when suffix ≥ ratio × prefix, default
     4); observability under ``Replica.stats()["catchup"]``.
 
+    Hierarchical anti-entropy (ISSUE 15, additive — default off):
+    ``tree_gossip=True`` replaces flat all-neighbour sync with a
+    deterministic membership-derived spanning tree
+    (:mod:`delta_crdt_ex_tpu.runtime.treesync`): every replica
+    computes the same tree from the sorted member set + ``tree_seed``
+    (no coordinator), leaves sync only their parent, and relays
+    coalesce inbound children's deltas into ONE merged re-emission per
+    link per epoch — O(fanout) links and bytes per member instead of
+    O(neighbours), with multi-hop propagation cascading through relays
+    instead of waiting a sync interval per generation. Co-located
+    members (same process endpoint, same pinned device, or one fleet /
+    mesh) cluster as a bottom-tier subtree whose captain alone gossips
+    outward. A parent/relay ``Down`` re-parents deterministically on
+    every observer; past ``tree_degrade_ratio`` locally-down members
+    the replica falls back to flat gossip until membership stabilises.
+    Knobs: ``tree_gossip``, ``tree_fanout`` (default 8), ``tree_seed``,
+    ``tree_degrade_ratio`` (default 0.25), ``tree_group`` (explicit
+    tier-0 cluster key); observability under
+    ``Replica.stats()["tree"]`` and the ``crdt_tree_*`` metric family.
+    Gated by ``bench.py --tree`` (propagation rounds + bytes-on-wire
+    vs flat gossip at 256 simulated peers, bit-for-bit parity).
+
     Observability plane (ISSUE 9, off by default): ``obs=True`` joins
     the process-wide :class:`~delta_crdt_ex_tpu.runtime.metrics.
     Observability` plane (``obs=<Observability>`` an explicit one) —
@@ -229,7 +251,15 @@ def start_fleet(
     between co-mesh members deliver as device-side ``ppermute``
     rotations (only off-mesh destinations take the TCP/frame path).
     Semantics are bit-for-bit the vmap fleet's — state, WAL bytes,
-    acks and wire bytes (``tests/test_mesh_fleet.py``)."""
+    acks and wire bytes (``tests/test_mesh_fleet.py``).
+
+    ``tree_gossip=True`` members (ISSUE 15) are stamped with ONE
+    shared tier-0 cluster key (the mesh plane's, when ``mesh=`` is on)
+    so the whole fleet forms a single bottom-tier subtree of the
+    gossip spanning tree: intra-fleet hops are local mailbox (or
+    ppermute) deliveries and only the captain gossips outward; relay
+    re-emissions ride the tick's frame collector / mesh exchange like
+    every other sync send."""
     if names is not None and len(names) != n:
         raise ValueError(f"{len(names)} names for {n} replicas")
     opts.setdefault("sync_interval", DEFAULT_SYNC_INTERVAL)

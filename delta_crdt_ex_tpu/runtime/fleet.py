@@ -351,6 +351,24 @@ class Fleet:
             # member notify() wakes the FLEET loop, not a per-replica one
             r.notify = self._member_notify  # type: ignore[method-assign]
             r._in_fleet = True
+        #: hierarchical anti-entropy tier 0 (ISSUE 15): tree-mode
+        #: members share ONE tier-0 cluster key, so the whole fleet is
+        #: a single bottom-tier subtree — intra-fleet hops are local
+        #: mailbox (or, in mesh mode, ppermute) deliveries, and only
+        #: the captain gossips outward. Mesh fleets key the cluster on
+        #: the mesh plane so the bottom tier IS the mesh.
+        if any(r.tree_gossip for r in self.replicas):
+            from delta_crdt_ex_tpu.runtime import treesync
+
+            if self._mesh_plane is not None:
+                group = self._mesh_plane.tree_group()
+            else:
+                group = treesync.fleet_group_key(
+                    [r.addr for r in self.replicas]
+                )
+            for r in self.replicas:
+                if r.tree_group is None:
+                    r.tree_group = group
         #: observability plane (ISSUE 9): the fleet registers its own
         #: varz/health sources + a scrape-time collector for occupancy /
         #: fill-ratio / tick gauges; members register themselves
@@ -408,6 +426,12 @@ class Fleet:
             if pairs:
                 self._dispatch_wave(pairs)
             wave += 1
+        # tree-mode relay epoch on the ingress side (ISSUE 15): what
+        # this tick's waves merged into relay members re-emits NOW, so
+        # multi-hop propagation cascades tick-by-tick through the fleet
+        # instead of waiting for each member's next periodic sync
+        for rep, _units in per_member:
+            rep._relay_flush()
         if n_msgs:
             with self._lock:
                 # tick/dispatch counters are read by stats() from any
@@ -820,6 +844,7 @@ class Fleet:
                     # plan, extraction, emission and walks)
                     rep._push_deltas(send)
                     rep._open_walks(send)
+                    rep._relay_flush(send)
                     continue
                 tv = lane_trees.get(id(rep))
                 if (
@@ -834,6 +859,11 @@ class Fleet:
                         sl = rep._extract_push_job(job)
                     rep._emit_push_job(job, sl, send)
                 rep._open_walks(send)
+                # tree-mode relay epoch (ISSUE 15): coalesced
+                # re-emissions ride the SAME tick send — fleet frames
+                # aggregate them per endpoint, and in mesh mode co-mesh
+                # links deliver through the ppermute exchange (tier 0)
+                rep._relay_flush(send)
 
         # phase 3.5 — the intra-mesh exchange: rotate buffered co-mesh
         # entries along the replica axis and deliver every buffered

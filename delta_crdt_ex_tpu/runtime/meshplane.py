@@ -98,6 +98,18 @@ class MeshPlane:
         n = len(self._members)
         return round(n / self.shards, 3) if self.shards else 0.0
 
+    def tree_group(self) -> tuple:
+        """The tier-0 cluster key for hierarchical anti-entropy
+        (ISSUE 15): every member of this mesh clusters as ONE
+        bottom-tier subtree of the gossip spanning tree — an intra-mesh
+        hop is a ``ppermute`` rotation, free relative to TCP, so the
+        bottom tier of the tree IS the mesh. Deterministic in the
+        assigned membership (any process knowing the member set derives
+        the same key)."""
+        from delta_crdt_ex_tpu.runtime.treesync import fleet_group_key
+
+        return ("mesh",) + fleet_group_key(list(self._members))[1:]
+
     def begin_tick(self) -> "_TickExchange":
         return _TickExchange(self)
 

@@ -54,6 +54,7 @@ from delta_crdt_ex_tpu.runtime import (
     telemetry,
     tracing,
     transition,
+    treesync,
 )
 from delta_crdt_ex_tpu.runtime.clock import Clock
 from delta_crdt_ex_tpu.runtime.storage import (
@@ -63,7 +64,12 @@ from delta_crdt_ex_tpu.runtime.storage import (
     name_key,
     require_layout,
 )
-from delta_crdt_ex_tpu.runtime.transport import Down, LocalTransport, default_transport
+from delta_crdt_ex_tpu.runtime.transport import (
+    Down,
+    LocalTransport,
+    default_transport,
+    forward_fleet_entries,
+)
 from delta_crdt_ex_tpu.runtime.wal import ReplayClock, WalLog
 
 logger = logging.getLogger("delta_crdt_ex_tpu")
@@ -217,6 +223,11 @@ class Replica:
         catchup_chunk_rows: int = 1024,
         catchup_suffix_ratio: float = 4.0,
         gc_interval_ops: int = 4096,
+        tree_gossip: bool = False,
+        tree_fanout: int = 8,
+        tree_seed: int = 0,
+        tree_degrade_ratio: float = 0.25,
+        tree_group=None,
         obs=None,
         device=None,
     ):
@@ -374,6 +385,67 @@ class Replica:
         self._neighbours: list[Any] = []
         self._monitors: set[Any] = set()
         self._outstanding: dict[Any, int] = {}
+        #: hierarchical anti-entropy (ISSUE 15): with ``tree_gossip``
+        #: on, sync edges are the replica's links in a deterministic
+        #: membership-derived spanning tree (runtime/treesync.py) —
+        #: leaves sync only their parent, relays coalesce inbound
+        #: children's deltas and re-emit ONE merged slice per link per
+        #: epoch (``_relay_flush``). Every replica derives the SAME
+        #: tree from the sorted member set + ``tree_seed`` (no
+        #: coordinator); ``Down``/rejoin/``set_neighbours`` invalidate
+        #: and re-derive, and past ``tree_degrade_ratio`` locally-down
+        #: members the replica degrades to flat gossip outright.
+        self.tree_gossip = bool(tree_gossip)
+        self.tree_fanout = int(tree_fanout)
+        if self.tree_gossip and self.tree_fanout < 2:
+            # fail HERE, not in the background loop's first derivation
+            raise ValueError(
+                f"tree_fanout must be >= 2, got {tree_fanout!r}"
+            )
+        self.tree_seed = int(tree_seed)
+        self.tree_degrade_ratio = float(tree_degrade_ratio)
+        #: tier-0 cluster key (``treesync.group_of``): a fleet stamps
+        #: its members with one shared key so they form a single
+        #: bottom-tier subtree whose captain alone gossips outward
+        self.tree_group = tree_group
+        self._tree_topo: "treesync.TreeTopology | None" = None
+        self._tree_down: set[Any] = set()
+        self._tree_degraded = False
+        self._tree_probe_ts = 0.0
+        #: REVERSE links: peers not in our tree view that keep opening
+        #: sync rounds toward us — evidence THEIR view has us as a link
+        #: (transiently divergent trees mid-churn, e.g. a re-parented
+        #: member whose new parent never observed the Down that moved
+        #: it). We sync back toward them (monitor + push + walk) until
+        #: they stop, which makes every view-edge bidirectional and
+        #: guarantees convergence without a membership gossip round;
+        #: entries expire ``addr -> monotonic deadline`` when the peer
+        #: goes quiet (its view caught up, or it left)
+        self._tree_reverse: dict[Any, float] = {}
+        #: relay coalescing state, all under ``_lock``: per-link ordered
+        #: pending bucket rows (dict used as an ordered set) awaiting
+        #: the next re-emission, per-link inbound messages folded since
+        #: that link last flushed, and inbound slice bytes accumulated
+        #: since the last flush (the rx side of the per-tier counters).
+        #: ``_relay_defer`` parks each merge's (sources, buckets,
+        #: kernel-count accessor) until the flush, which fetches every
+        #: parked count pytree with ONE batched ``device_get`` and
+        #: stamps pending rows only for messages that actually CHANGED
+        #: state — a no-op merge relays nothing, which is what bounds
+        #: the cascade when transiently divergent tree views form a
+        #: cycle (and what keeps redundant walk transfers from
+        #: triggering whole-subtree re-sweeps).
+        self._relay_defer: list = []
+        self._relay_pending: dict[Any, dict[int, None]] = {}
+        self._relay_fold: dict[Any, int] = {}
+        self._relay_rx_pending = 0
+        self._relay_reemits = 0
+        self._relay_msgs_folded = 0
+        self._relay_entries_emitted = 0
+        self._relay_rows_emitted = 0
+        self._relay_tx_bytes = 0
+        self._relay_rx_bytes = 0
+        self._relay_depth_hist: dict[int, int] = {}
         #: ingress coalescing (ISSUE 3): the event loop drains a bounded
         #: batch of queued messages and joins compatible EntriesMsg
         #: groups with ONE grouped fan-in kernel dispatch instead of one
@@ -1060,6 +1132,22 @@ class Replica:
                 a: s for a, s in self._sync_open_seq.items() if a in addrs
             }
             self._catchup = {a: s for a, s in self._catchup.items() if a in addrs}
+            if self.tree_gossip:
+                # membership moved: re-derive the spanning tree (every
+                # replica fed the same member list lands on the same
+                # topology), and forget failure/relay state for members
+                # that left
+                self._tree_topo = None
+                self._tree_down &= set(addrs)
+                self._relay_pending = {
+                    a: p for a, p in self._relay_pending.items() if a in addrs
+                }
+                self._relay_fold = {
+                    a: c for a, c in self._relay_fold.items() if a in addrs
+                }
+                self._tree_reverse = {
+                    a: t for a, t in self._tree_reverse.items() if a in addrs
+                }
             # the sync below opens a round toward every (re)gained peer;
             # its opener carries our seq + log horizon, and a peer whose
             # watermark is within the horizon answers with GetLogMsg —
@@ -1442,6 +1530,46 @@ class Replica:
             )
         )
 
+    def canonical_state_bytes(self) -> bytes:
+        """Topology-independent canonical projection of the CRDT state:
+        the sorted per-key LWW winner records plus the causal context
+        re-keyed by writer gid (writer-slot assignment order and entry
+        lane placement are arrival-order artifacts — two replicas that
+        merged the same dot set in different orders agree on THIS
+        projection bit-for-bit). The parity gate hierarchical
+        anti-entropy's tree-vs-flat legs assert in-run (``bench.py
+        --tree``, ``tests/test_tree_sync.py``)."""
+        with self._lock:
+            self._flush()
+            key, gid, ctr, valh, ts = self._winner_arrays_rows(None)
+            order = np.lexsort((ts, valh, ctr, gid, key))
+            winners = np.stack(
+                [
+                    key[order].astype(np.uint64),
+                    gid[order].astype(np.uint64),
+                    ctr[order].astype(np.uint64),
+                    valh[order].astype(np.uint64),
+                    ts[order].astype(np.uint64),
+                ],
+                1,
+            )
+            st = self.state
+            gids = np.asarray(st.ctx_gid)
+            ctx = np.asarray(st.ctx_max)
+            # writers with an all-zero context column are arrival
+            # artifacts (a slice's first-appearance-unioned writer table
+            # registers its SOURCE's gid even when no dot of that writer
+            # rode along — how many such slots exist depends on who you
+            # happened to sync with), so the canonical context keeps
+            # only writers that contributed coverage
+            live = np.nonzero((gids != 0) & ctx.any(axis=0))[0]
+            g_order = live[np.argsort(gids[live], kind="stable")]
+            return (
+                winners.tobytes()
+                + gids[g_order].tobytes()
+                + ctx[:, g_order].tobytes()
+            )
+
     def _note_state_changed(
         self, count_fn: Callable[[], int], keep_read_cache: bool = False
     ) -> None:
@@ -1594,9 +1722,14 @@ class Replica:
         digest-walk round (the repair + transitive-relay path)."""
         with self._lock:
             self._flush()
+            if self.tree_gossip:
+                self._tree_probe_down()
             self._monitor_neighbours()
             self._push_deltas()
             self._open_walks()
+        # the tick's relay epoch: everything merged since the last flush
+        # re-emits as ONE merged slice per tree link (no-op when flat)
+        self._relay_flush()
 
     def _open_walks(self, send=None) -> None:
         """Open digest-walk rounds toward every monitored neighbour —
@@ -1782,7 +1915,25 @@ class Replica:
                     self._rm_cursor[n] = job.new_cursor
 
     def _monitor_neighbours(self) -> None:
-        for n in self._neighbours:
+        topo = self._tree_refresh()
+        if topo is None:
+            targets = list(self._neighbours)
+        else:
+            links = topo.links(self.addr)
+            now = time.monotonic()
+            for a in [
+                a for a, t in self._tree_reverse.items() if t <= now
+            ]:
+                # the peer stopped syncing us: its view caught up (or it
+                # left) — retire the reverse edge
+                del self._tree_reverse[a]
+                if a not in links and a in self._monitors:
+                    self.transport.demonitor(self.addr, a)
+                    self._monitors.discard(a)
+            targets = links + [
+                a for a in self._tree_reverse if a not in links
+            ]
+        for n in targets:
             if n in self._monitors:
                 continue
             if self.transport.monitor(self.addr, n):
@@ -1791,8 +1942,318 @@ class Replica:
                 # after this, and the opener's seq + log horizon lets the
                 # rejoined peer choose log-shipped catch-up over the walk
                 self._monitors.add(n)
+                if n in self._tree_down:
+                    # a tree link came back: re-derive so the rejoined
+                    # member regains its deterministic slot
+                    self._tree_down.discard(n)
+                    self._tree_topo = None
             else:
                 logger.debug("tried to monitor a dead neighbour: %r", n)
+                if topo is not None and n != self.addr:
+                    # an unmonitorable TREE LINK is a down observation:
+                    # re-derive now instead of stalling this edge until
+                    # a Down message that may never come (we were not
+                    # monitoring yet) — the deterministic mid-epoch
+                    # re-parent path
+                    self._tree_down.add(n)
+                    self._tree_topo = None
+
+    # -- hierarchical anti-entropy (ISSUE 15 tentpole) -------------------
+    #
+    # Tree mode re-points the EXISTING sync machinery at the replica's
+    # spanning-tree links instead of the whole neighbour set: the
+    # monitors (and through them _eager_jobs / _open_walks / the
+    # full-row push) only ever cover links, so own deltas ride the
+    # unchanged delta-interval path up/down one edge. What's new is the
+    # RELAY: merged inbound slices are re-emitted onward (coalesced —
+    # one merged extraction per link per epoch, not N forwarded
+    # frames), which is what turns a tree of bounded-degree edges into
+    # whole-fleet propagation without per-generation walk latency.
+
+    def _tree_refresh(self) -> "treesync.TreeTopology | None":
+        """The current spanning tree, derived lazily and memoised until
+        membership/failure state moves — or ``None`` when this replica
+        gossips flat (tree mode off, or degraded past
+        ``tree_degrade_ratio`` locally-observed down members). Caller
+        holds the lock."""
+        if not self.tree_gossip:
+            return None
+        members = set(self._neighbours) | {self.addr}
+        down = self._tree_down & members
+        if treesync.too_damaged(
+            len(members), len(down), self.tree_degrade_ratio
+        ):
+            if not self._tree_degraded:
+                self._tree_degraded = True
+                self._tree_topo = None
+                self._flight(
+                    "tree_degrade", down=len(down), members=len(members)
+                )
+                self._tree_telemetry(None, len(members), len(down))
+            return None
+        if self._tree_degraded:
+            # membership recovered: re-derive out of flat fallback
+            self._tree_degraded = False
+            self._tree_topo = None
+        topo = self._tree_topo
+        if topo is not None:
+            return topo
+        transport = self.transport
+        topo = treesync.derive_tree(
+            members,
+            fanout=self.tree_fanout,
+            seed=self.tree_seed,
+            down=down,
+            group_key=lambda a: treesync.group_of(transport, a),
+        )
+        self._tree_topo = topo
+        # monitors narrow to the new links (+ live reverse edges); a
+        # dropped link must not keep feeding _eager_jobs/_open_walks
+        # (stale cursors stay — soft state, keyed per addr, re-covered
+        # if the edge ever returns)
+        links = set(topo.links(self.addr)) | set(self._tree_reverse)
+        for a in [m for m in self._monitors if m not in links]:
+            self.transport.demonitor(self.addr, a)
+            self._monitors.discard(a)
+            self._outstanding.pop(a, None)
+        self._flight(
+            "tree_epoch", epoch=topo.epoch, role=topo.role(self.addr),
+            tier=int(topo.tier.get(self.addr, 0)), depth=topo.depth,
+        )
+        self._tree_telemetry(topo, len(members), len(down))
+        return topo
+
+    _TREE_ROLE_CODE = {"leaf": 0, "relay": 1, "root": 2}
+
+    def _tree_telemetry(self, topo, members: int, down: int) -> None:
+        if telemetry.has_handlers(telemetry.TREE_TOPOLOGY):
+            telemetry.execute(
+                telemetry.TREE_TOPOLOGY,
+                {
+                    "depth": 0 if topo is None else topo.depth,
+                    "fanout": self.tree_fanout,
+                    "tier": (
+                        0 if topo is None
+                        else int(topo.tier.get(self.addr, 0))
+                    ),
+                    "role": (
+                        0 if topo is None
+                        else self._TREE_ROLE_CODE[topo.role(self.addr)]
+                    ),
+                    "members": members,
+                    "down": down,
+                    "degraded": int(topo is None),
+                },
+                {"name": self.name},
+            )
+
+    def _tree_probe_down(self) -> None:
+        """Throttled liveness probe of locally-down NON-link members (a
+        link rejoin is observed by ``_monitor_neighbours`` directly):
+        without this, a down member that never re-enters our links would
+        stay excluded from the tree forever. Caller holds the lock."""
+        if not self._tree_down:
+            return
+        now = time.monotonic()
+        if now < self._tree_probe_ts + max(2 * self.sync_interval, 1.0):
+            return
+        self._tree_probe_ts = now
+        rejoined = [a for a in self._tree_down if self.transport.alive(a)]
+        if rejoined:
+            self._tree_down.difference_update(rejoined)
+            self._tree_topo = None
+
+    def _relay_note_merge(self, msgs: list, counts_fn, offsets=None) -> None:
+        """Record one committed merge for later relay stamping: each
+        message's (source, bucket rows) park with the kernel's raw
+        insert/kill count accessor until the next ``_relay_flush``,
+        which fetches every parked accounting pytree in ONE batched
+        ``device_get`` and stamps pending rows toward every tree link
+        EXCEPT the source edge — and ONLY for messages whose merge
+        actually changed state. The changed-only gate is load-bearing,
+        not an optimisation: a no-op merge relays nothing, so a cycle
+        formed by transiently divergent tree views (mid-churn, before
+        every replica observed the same Down) terminates as soon as the
+        content stops being news. ``counts_fn`` must hand back the raw
+        device values (never ``int()`` them here — that would serialise
+        a sync round trip per dispatch group, the exact cost class the
+        drain's deferral window exists to batch). Caller holds the
+        lock."""
+        if not self.tree_gossip or self._replaying:
+            return
+        topo = self._tree_refresh()
+        if topo is None or not topo.links(self.addr):
+            return
+        metas = []
+        for m in msgs:
+            rows = [int(b) for b in np.asarray(m.buckets).tolist()]
+            nbytes = sum(
+                int(v.nbytes)
+                for v in m.arrays.values()
+                if hasattr(v, "nbytes")
+            )
+            metas.append((m.frm, rows, nbytes))
+        self._relay_defer.append((metas, counts_fn, offsets))
+
+    @staticmethod
+    def _relay_changed_per_msg(data, offsets, depth: int) -> list:
+        """Per-message changed-entry counts from one fetched accounting
+        pytree: whole-slice scalars for a solo merge, per-row arrays +
+        member offsets for a grouped dispatch."""
+        ins, kill = data
+        if offsets is None:
+            return [int(np.asarray(ins)) + int(np.asarray(kill))]
+        tot = np.cumsum(np.asarray(ins, np.int64) + np.asarray(kill, np.int64))
+        out = []
+        for lo, hi in offsets[:depth]:
+            if hi > lo:
+                out.append(int(tot[hi - 1]) - (int(tot[lo - 1]) if lo else 0))
+            else:
+                out.append(0)
+        return out
+
+    def _relay_stamp_deferred(self, topo) -> None:
+        """Drain the parked merges into per-link pending rows (caller
+        holds the lock): one batched transfer for every parked count
+        pytree, then host-only stamping."""
+        defer, self._relay_defer = self._relay_defer, []
+        if not defer:
+            return
+        links = topo.links(self.addr)
+        fetched = jax.device_get([fn() for _m, fn, _o in defer])
+        for (metas, _fn, offsets), data in zip(defer, fetched):
+            changed = self._relay_changed_per_msg(data, offsets, len(metas))
+            for (frm, rows, nbytes), n_changed in zip(metas, changed):
+                if not rows or not n_changed:
+                    continue
+                self._relay_rx_pending += nbytes
+                for a in links:
+                    if a == frm:
+                        continue
+                    pend = self._relay_pending.setdefault(a, {})
+                    for b in rows:
+                        pend[b] = None
+                    self._relay_fold[a] = self._relay_fold.get(a, 0) + 1
+
+    def _relay_flush(self, send=None) -> int:
+        """Re-emit pending relayed rows: for each group of links whose
+        pending window is identical (in steady fan-in that is every
+        non-source link), extract the union of touched buckets from the
+        MERGED state ONCE (``extract_rows`` — the walk's own idempotent
+        full-row transfer shape, so a lost re-emission heals like any
+        lost walk transfer) and fan the slice out — N inbound children
+        frames become one merged re-emission upward/downward per epoch,
+        PR 3's fan-in coalescing generalised from one mailbox to
+        multi-hop. Bounded by ``max_sync_size`` rows per link per
+        flush; the remainder stays pending. Returns messages emitted."""
+        if not self.tree_gossip:
+            return 0
+        with self._lock:
+            if not self._relay_pending and not self._relay_defer:
+                return 0
+            topo = self._tree_refresh()
+            if topo is None:
+                # degraded to flat: every member hears writers directly
+                # again, and the periodic walks heal anything in flight
+                self._relay_defer.clear()
+                self._relay_pending.clear()
+                self._relay_fold.clear()
+                self._relay_rx_pending = 0
+                return 0
+            self._relay_stamp_deferred(topo)
+            if not self._relay_pending:
+                return 0
+            t0 = time.perf_counter()
+            links = set(topo.links(self.addr))
+            for a in [a for a in self._relay_pending if a not in links]:
+                self._relay_pending.pop(a, None)
+                self._relay_fold.pop(a, None)
+            limit = int(min(self.max_sync_size, self.num_buckets))
+            groups: dict[tuple, list] = {}
+            for a, pend in self._relay_pending.items():
+                batch = tuple(list(pend)[:limit])
+                if batch:
+                    groups.setdefault(batch, []).append(a)
+            if not groups:
+                return 0
+            send = self.transport.send if send is None else send
+            emitted: list[dict] = []
+            for batch, peers in groups.items():
+                rows = np.full(_wire(max(len(batch), 1)), -1, np.int32)
+                rows[: len(batch)] = batch
+                sl = self.model.extract_rows(self.state, jnp.asarray(rows))
+                bodies, payloads = self._slice_bodies(sl, rows, peers)
+                buckets = np.asarray(batch, np.int64)
+                for a in peers:
+                    msg = sync_proto.EntriesMsg(
+                        originator=self.addr,
+                        frm=self.addr,
+                        to=a,
+                        buckets=buckets,
+                        arrays=bodies[a],
+                        payloads=payloads,
+                    )
+                    if not send(a, msg):
+                        continue
+                    pend = self._relay_pending.get(a)
+                    drained = False
+                    if pend is not None:
+                        for b in batch:
+                            pend.pop(b, None)
+                        if not pend:
+                            self._relay_pending.pop(a, None)
+                            drained = True
+                    # fold accounting is per COMPLETED window: a
+                    # max_sync_size-truncated flush leaves the link's
+                    # fold count in place (new inbound keeps adding to
+                    # it) and this continuation emission contributes no
+                    # depth sample — attributing the whole count to the
+                    # first partial emission would skew the coalesce-
+                    # depth histogram with one inflated and K spurious
+                    # zero samples
+                    folded = self._relay_fold.pop(a, 0) if drained else None
+                    tx = sum(
+                        int(v.nbytes)
+                        for v in bodies[a].values()
+                        if hasattr(v, "nbytes")
+                    )
+                    self._relay_reemits += 1
+                    self._relay_entries_emitted += len(payloads)
+                    self._relay_rows_emitted += len(batch)
+                    self._relay_tx_bytes += tx
+                    meas = {
+                        "entries": len(payloads),
+                        "buckets": len(batch),
+                        "tx_bytes": tx,
+                        "rx_bytes": 0,
+                        "duration_s": 0.0,
+                    }
+                    if folded is not None:
+                        self._relay_msgs_folded += folded
+                        self._relay_depth_hist[folded] = (
+                            self._relay_depth_hist.get(folded, 0) + 1
+                        )
+                        meas["depth"] = folded
+                    emitted.append(meas)
+            if not emitted:
+                return 0
+            rx, self._relay_rx_pending = self._relay_rx_pending, 0
+            self._relay_rx_bytes += rx
+            if telemetry.has_handlers(telemetry.TREE_RELAY):
+                # flush-level quantities ride the first message's row
+                # (the batch fold sums them; per-message histograms stay
+                # exact either way)
+                emitted[0]["rx_bytes"] = rx
+                emitted[0]["duration_s"] = time.perf_counter() - t0
+                telemetry.execute_many(
+                    telemetry.TREE_RELAY,
+                    emitted,
+                    {
+                        "name": self.name,
+                        "tier": str(int(topo.tier.get(self.addr, 0))),
+                    },
+                )
+            return len(emitted)
 
     # -- message handlers ------------------------------------------------
 
@@ -1823,6 +2284,16 @@ class Replica:
             elif isinstance(msg, Down):
                 self._monitors.discard(msg.addr)
                 self._outstanding.pop(msg.addr, None)
+                if self.tree_gossip:
+                    # deterministic mid-epoch re-parent: every replica
+                    # that observed this Down derives the same tree over
+                    # the surviving members on its next refresh (or
+                    # degrades to flat gossip past the damage threshold)
+                    self._tree_down.add(msg.addr)
+                    self._tree_topo = None
+                    self._relay_pending.pop(msg.addr, None)
+                    self._relay_fold.pop(msg.addr, None)
+                    self._tree_reverse.pop(msg.addr, None)
                 # a dead peer must not gate segment reclaim forever
                 self._ack_seq.pop(msg.addr, None)
                 self._sync_open_seq.pop(msg.addr, None)
@@ -1841,14 +2312,41 @@ class Replica:
         fallback for transports that hand the envelope to a mailbox
         whole: entries addressed to this replica dispatch through the
         normal ladder (the RLock makes the recursive :meth:`handle`
-        re-entry a no-op acquire), everything else forwards unopened."""
-        for to, m in msg.entries:
+        re-entry a no-op acquire), everything else forwards unopened —
+        REGROUPED per next-hop endpoint into one rewritten envelope
+        each (ISSUE 15: an intermediate hop rewrites ``entries`` in
+        place, inner messages untouched) when the transport can frame,
+        with the per-member send as the renegotiated-down/legacy
+        fallback."""
+        def local(to, m) -> bool:
             if to == self.addr or to == self.name:
                 self.handle(m)
-            else:
-                self.transport.send(to, m)
+                return True
+            return False
+
+        forward_fleet_entries(self.transport, msg.entries, local)
 
     def _handle_diff(self, msg: sync_proto.DiffMsg) -> None:
+        if (
+            self.tree_gossip
+            and msg.frm != self.addr
+            and msg.originator == msg.frm
+        ):
+            # ORIGINATOR frames only (openers + the originator's deeper
+            # blocks): those prove the peer's own view has us as a sync
+            # target. Mid-walk replies in rounds WE originated must not
+            # qualify — our own polling of a reverse peer would then
+            # refresh its deadline forever, turning every transient
+            # view divergence into a permanent extra flat edge.
+            topo = self._tree_refresh()
+            if topo is not None and msg.frm not in topo.links(self.addr):
+                # a non-link peer syncing us: ITS tree view has us as a
+                # link (divergent views mid-churn) — sync back toward it
+                # until it stops, so every view-edge is bidirectional
+                # and mixed-epoch topologies still converge
+                self._tree_reverse[msg.frm] = time.monotonic() + max(
+                    6 * self.sync_interval, 3.0
+                )
         self._flush()
         tree = self._ensure_tree()
         end_level, end_idx = sync_proto.walk(
@@ -2113,6 +2611,14 @@ class Replica:
             return
 
         self._seq += 1
+        # relay bookkeeping (ISSUE 15): the merged rows park for the
+        # next flush's changed-only stamping toward every tree link
+        # except the source edge — default-arg capture of JUST the two
+        # count scalars (closing over ``res`` would pin the whole
+        # MergeRowsResult, state included, across the relay window)
+        self._relay_note_merge(
+            [msg], lambda ins=res.n_inserted, kill=res.n_killed: (ins, kill)
+        )
         if want_diffs:
             keys_a = self._winner_records_rows(rows_np[rows_np >= 0])
             touched: dict[int, Any] = {}
@@ -2769,6 +3275,13 @@ class Replica:
         # batched): state stored, payloads registered — publish for the
         # serving plane's lock-free readers
         self._publish_serve()
+        # relay bookkeeping (ISSUE 15) shares this tail too, so the
+        # grouped solo path and the fleet batched path park their relay
+        # stamps identically (the singleton path parks in
+        # _handle_entries_inner); counts_fn is the same raw-device
+        # accessor the SYNC_DONE deferral consumes — calling it twice
+        # just hands back the same arrays
+        self._relay_note_merge(msgs, counts_fn, offsets)
         depth = len(msgs)
         want_done = telemetry.has_handlers(telemetry.SYNC_DONE)
         want_round = telemetry.has_handlers(telemetry.SYNC_ROUND)
@@ -3112,6 +3625,11 @@ class Replica:
                 self._handle_batch(batch)
                 if drain is None or len(batch) < self.ingress_batch:
                     break
+            # end-of-drain relay epoch (ISSUE 15): everything this pass
+            # merged re-emits as ONE coalesced slice per tree link, so
+            # propagation cascades hop-by-hop through relays instead of
+            # waiting a sync interval per tree level
+            self._relay_flush()
         finally:
             if top:
                 with self._lock:
@@ -3222,6 +3740,46 @@ class Replica:
                 },
                 "wal": None,
             }
+            if self.tree_gossip:
+                topo = self._tree_refresh()
+                reemits = self._relay_reemits
+                out["tree"] = {
+                    "degraded": topo is None,
+                    "epoch": None if topo is None else topo.epoch,
+                    "role": (
+                        "flat" if topo is None else topo.role(self.addr)
+                    ),
+                    "tier": (
+                        0 if topo is None
+                        else int(topo.tier.get(self.addr, 0))
+                    ),
+                    "depth": 0 if topo is None else topo.depth,
+                    "fanout": self.tree_fanout,
+                    "members": (
+                        0 if topo is None else len(topo.members)
+                    ),
+                    "down": len(self._tree_down),
+                    "links": (
+                        [] if topo is None
+                        else [str(a) for a in topo.links(self.addr)]
+                    ),
+                    "reemits": reemits,
+                    "msgs_folded": self._relay_msgs_folded,
+                    "folds_per_reemit": (
+                        round(self._relay_msgs_folded / reemits, 3)
+                        if reemits
+                        else 0.0
+                    ),
+                    "entries_reemitted": self._relay_entries_emitted,
+                    "rows_reemitted": self._relay_rows_emitted,
+                    "tx_bytes": self._relay_tx_bytes,
+                    "rx_bytes": self._relay_rx_bytes,
+                    "depth_hist": dict(sorted(self._relay_depth_hist.items())),
+                    "pending_links": len(self._relay_pending),
+                    "pending_rows": sum(
+                        len(p) for p in self._relay_pending.values()
+                    ),
+                }
             if self._wal is not None:
                 out["wal"] = {
                     "uncompacted_records": self._wal_unc,
@@ -3267,7 +3825,12 @@ class Replica:
                     < max(5 * self.sync_interval, 2.0)
                 )
             wal_ok = self._wal is None or os.access(self._wal.directory, os.W_OK)
-            neighbours = [n for n in self._neighbours if n != self.addr]
+            # tree mode: readiness is about OUR sync edges (the tree
+            # links), not the whole membership — a leaf monitoring only
+            # its parent is healthy by design
+            topo = self._tree_refresh()
+            targets = self._neighbours if topo is None else topo.links(self.addr)
+            neighbours = [n for n in targets if n != self.addr]
             unreachable = [n for n in neighbours if n not in self._monitors]
         return {
             "ok": loop_ok and wal_ok and not unreachable,

@@ -38,7 +38,7 @@ import time
 from typing import Any, Hashable
 
 from delta_crdt_ex_tpu.runtime import sync as sync_proto
-from delta_crdt_ex_tpu.runtime.transport import Down
+from delta_crdt_ex_tpu.runtime.transport import Down, forward_fleet_entries
 
 logger = logging.getLogger("delta_crdt_ex_tpu")
 
@@ -607,6 +607,20 @@ class TcpTransport:
         payload = _encode_msgb(fm, min_bytes=0)
         return conn.enqueue(_FLEETF, payload)
 
+    def _deliver_fleet_frame(self, fm) -> None:
+        """Fan one received fleet envelope out: local entries deliver to
+        mailboxes in send order; entries addressed to members of ANOTHER
+        process — a relay hop in hierarchical anti-entropy (ISSUE 15) —
+        regroup by next-hop endpoint and re-emit as ONE rewritten
+        ``_FLEETF`` frame each (the envelope's ``entries`` rewritten in
+        place, inner messages untouched) instead of N per-member remote
+        frames. Per-destination order is preserved (grouping never
+        reorders entries sharing a destination); a legacy/dead next hop
+        falls back to per-member sends exactly like the sender-side
+        renegotiated-down path. One shared policy with the replica's
+        whole-envelope fallback (``transport.forward_fleet_entries``)."""
+        forward_fleet_entries(self, fm.entries)
+
     def queue_depth(self, addr: Hashable) -> int:
         """Queued messages in one LOCAL mailbox (the observability
         plane's mailbox-depth gauge; same contract as LocalTransport)."""
@@ -765,8 +779,7 @@ class TcpTransport:
                     # mailbox deliveries, in send order (per-(sender,
                     # receiver) ordering is exactly the per-member path's)
                     fm = _decode_msgb(payload)
-                    for to, m in fm.entries:
-                        self.send(to, m)
+                    self._deliver_fleet_frame(fm)
                 elif not warned_unknown:
                     # once per connection: a misbehaving/newer peer
                     # streaming frames must not flood the log

@@ -162,6 +162,29 @@ class LocalTransport:
         raise RuntimeError("transport did not quiesce")
 
 
+def forward_fleet_entries(transport, entries, local=None) -> None:
+    """THE fleet-envelope relay policy (ISSUE 15), shared by the TCP
+    receive path and the replica's whole-envelope fallback so the two
+    cannot drift: entries ``local`` claims (returns True) are done;
+    the rest regroup per next-hop endpoint (``transport.fleet_sink``)
+    and re-emit as ONE rewritten frame each — per-destination order
+    preserved, inner messages untouched — with per-member
+    ``transport.send`` for sink-less destinations and the
+    renegotiated-down unbundle inside ``send_fleet_frame`` itself."""
+    sink_of = getattr(transport, "fleet_sink", None)
+    forwards: dict = {}
+    for to, m in entries:
+        if local is not None and local(to, m):
+            continue
+        sink = sink_of(to) if sink_of is not None else None
+        if sink is None:
+            transport.send(to, m)
+        else:
+            forwards.setdefault(sink, []).append((to, m))
+    for endpoint, group in forwards.items():
+        transport.send_fleet_frame(endpoint, group)
+
+
 _default: LocalTransport | None = None
 _default_lock = threading.Lock()
 

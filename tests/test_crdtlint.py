@@ -3294,3 +3294,59 @@ def test_mutation_adhoc_static_lanes_is_caught():
     assert any(
         f.rule == "SHAPE002" and "lanes=" in f.message for f in new
     ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_relay_flush_lock_deleted_is_caught():
+    """ISSUE 15 acceptance: deleting the relay flush's lock acquisition
+    in the REAL replica turns the gate red — the relay pending/counter
+    state is replica-lock-guarded, and a lock-free flush is exactly the
+    unlocked-counter class LOCK001/RACE hunt for (the relay module's
+    state joins the existing thread graph)."""
+    rel = f"{PKG}/runtime/replica.py"
+    src = (REPO_ROOT / rel).read_text()
+    i = src.index("def _relay_flush")
+    j = src.index("with self._lock:", i)
+    anchor = "with self._lock:"
+    mutated = src[:j] + "if True:        " + src[j + len(anchor):]
+    new, _, _ = run_lint([REPO_ROOT / PKG], overlay={rel: mutated})
+    rules = {f.rule for f in new}
+    assert "LOCK001" in rules or "RACE001" in rules, rules
+
+
+def test_mutation_unguarded_tree_relay_emission_is_caught():
+    """ISSUE 15 acceptance: removing the ``has_handlers`` guard on the
+    relay flush's TREE_RELAY emission turns the gate red (OBS002) —
+    disabled telemetry would rebuild the per-re-emission measurement
+    dicts on every flush."""
+    rel = f"{PKG}/runtime/replica.py"
+    anchor = "if telemetry.has_handlers(telemetry.TREE_RELAY):"
+    assert anchor in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(rel, lambda s: s.replace(anchor, "if True:", 1))
+    assert any(
+        f.rule == "OBS002" and "TREE_RELAY" in f.message for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_relay_closure_capturing_slice_is_caught():
+    """ISSUE 15 acceptance: a relay-flush closure re-widened to capture
+    the extracted slice pytree and parked in the drain's deferral list
+    turns the gate red (LEAK001) — extraction results hold device
+    buffers sliced off the live store generation, the same
+    buffer-pinning class as parking a whole MergeRowsResult."""
+    rel = f"{PKG}/runtime/replica.py"
+    anchor = (
+        "                        self._relay_depth_hist[folded] = (\n"
+        "                            self._relay_depth_hist.get(folded, 0) + 1\n"
+        "                        )\n"
+    )
+    assert anchor in (REPO_ROOT / rel).read_text()
+    inject = anchor + (
+        "                    if self._telemetry_defer is not None:\n"
+        "                        self._telemetry_defer.append(\n"
+        "                            (lambda: sl, lambda _x: None)\n"
+        "                        )\n"
+    )
+    new = _overlay_lint(rel, lambda s: s.replace(anchor, inject, 1))
+    assert any(
+        f.rule == "LEAK001" and "_relay_flush" in f.message for f in new
+    ), "\n".join(f.render() for f in new)

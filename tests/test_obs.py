@@ -246,6 +246,77 @@ def test_mesh_gauges_scrape_and_unregister(transport):
         plane.close()
 
 
+def test_tree_gauges_scrape_and_unregister(transport):
+    """ISSUE 15 satellite: tree-mode replicas export the ``crdt_tree_*``
+    surface — topology gauges (depth/fanout/role/tier) kept fresh by
+    the TREE_TOPOLOGY bridge row, relay coalesce-depth and
+    entries-per-re-emit histograms plus per-tier tx/rx byte counters
+    fed by TREE_RELAY — and ``unregister_replica`` (via ``stop``)
+    removes the gauges so a stopped replica never scrapes stale."""
+    plane = Observability()
+    try:
+        reps = [
+            start_link(
+                threaded=False, transport=transport, obs=plane,
+                name=f"tobs{i}", node_id=500 + i, tree_gossip=True,
+                tree_fanout=2, sync_timeout=600.0,
+            )
+            for i in range(4)
+        ]
+        for r in reps:
+            r.set_neighbours([x.addr for x in reps])
+        reps[0].mutate("add", ["k", "v"])
+        for _ in range(4):
+            for r in reps:
+                r.sync_to_all()
+            for _ in range(50):
+                if not sum(r.process_pending() for r in reps):
+                    break
+        assert all(r.read().get("k") == "v" for r in reps)
+        out = plane.registry.render()
+        for name in reps:
+            lb = f'name="{name.name}"'
+            assert f"crdt_tree_fanout{{{lb}}} 2" in out
+            assert re.search(rf'crdt_tree_depth\{{{lb}\}} [1-9]', out)
+            assert re.search(rf'crdt_tree_role\{{{lb}\}} [0-2]', out)
+            assert re.search(rf'crdt_tree_tier\{{{lb}\}} \d', out)
+            assert f"crdt_tree_members{{{lb}}} 4" in out
+            assert f"crdt_tree_degraded{{{lb}}} 0" in out
+        # at least one relay re-emitted: the histograms + per-tier byte
+        # counters carry its TREE_RELAY stream
+        m = re.search(r'crdt_tree_reemits_total\{name="([^"]+)"\} (\d+)', out)
+        assert m and int(m.group(2)) >= 1, out[:2000]
+        relay_name = m.group(1)
+        assert re.search(
+            rf'crdt_tree_relay_coalesce_depth_count\{{name="{relay_name}"\}} \d',
+            out,
+        )
+        assert re.search(
+            rf'crdt_tree_entries_per_reemit_count\{{name="{relay_name}"\}} \d',
+            out,
+        )
+        assert re.search(
+            rf'crdt_tree_tx_bytes_total\{{name="{relay_name}",tier="\d+"\}} \d',
+            out,
+        )
+        assert re.search(
+            rf'crdt_tree_rx_bytes_total\{{name="{relay_name}",tier="\d+"\}} \d',
+            out,
+        )
+        stopped = reps[0].name
+        reps[0].stop()
+        out = plane.registry.render()
+        for metric in (
+            "crdt_tree_depth", "crdt_tree_fanout", "crdt_tree_role",
+            "crdt_tree_tier", "crdt_tree_members", "crdt_tree_degraded",
+        ):
+            assert f'{metric}{{name="{stopped}"}}' not in out
+        for r in reps[1:]:
+            r.stop()
+    finally:
+        plane.close()
+
+
 def test_serve_gauges_scrape_and_unregister_replica(transport):
     """ISSUE 14 satellite: a replica's serving front door exports the
     ``crdt_serve_*`` surface (polled pending/overloaded gauges + the

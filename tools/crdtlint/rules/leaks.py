@@ -52,8 +52,9 @@ from tools.crdtlint.rules import (
 
 RULE = "LEAK001"
 
-#: modules whose nested defs are checked (the drain/tick hot paths)
-_HOT_LEAVES = {"replica", "fleet", "serve"}
+#: modules whose nested defs are checked (the drain/tick hot paths;
+#: ``treesync`` is ISSUE 15's relay module)
+_HOT_LEAVES = {"replica", "fleet", "serve", "treesync"}
 
 #: call leaves returning kernel-result pytrees / new store generations
 _KERNEL_LEAVES = {
@@ -61,6 +62,11 @@ _KERNEL_LEAVES = {
     "merge_rows_into", "merge_group_into", "merge_into", "tier_retry_merge",
     "fleet_merge_rows", "fleet_row_apply", "fleet_compact_rows",
     "grow", "grow_table", "rehash", "stack_states", "stack_pytrees",
+    # extraction results hold device buffers sliced off the live store
+    # generation (ISSUE 15: the relay flush extracts per epoch) —
+    # parking one in a deferral closure pins exactly like a merge result
+    "extract_rows", "extract_own_delta", "interval_slices",
+    "fleet_extract_rows", "fleet_extract_own_delta",
 }
 #: attribute-name substrings that make a value "heavy" (a store pytree
 #: or a stacked state)

@@ -44,7 +44,11 @@ RULE_GUARD = "OBS002"
 #: ``serve`` (ISSUE 14): the serving front door emits per-commit and
 #: per-read telemetry — the client hot path pays for unguarded dict
 #: builds exactly like the ingest path does
-_HOT_LEAVES = {"replica", "fleet", "serve", "transport", "tcp_transport"}
+#: ``treesync`` (ISSUE 15): the relay module's helpers run on the
+#: drain/tick hot paths like replica/fleet code
+_HOT_LEAVES = {
+    "replica", "fleet", "serve", "transport", "tcp_transport", "treesync",
+}
 
 
 def _telemetry_module(project: Project) -> ModuleInfo | None:

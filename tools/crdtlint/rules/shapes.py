@@ -58,6 +58,7 @@ RULE_STATIC = "SHAPE002"
 _SHELL_LEAVES = {
     "replica", "fleet", "binned_map", "hash_store", "transition", "meshplane",
     "serve",  # ISSUE 14: snapshot reads dispatch winners_for_keys directly
+    "treesync",  # ISSUE 15: the relay module rides the jit-dispatch shell
 }
 
 #: tier/pad sanitiser seeds (import-resolved; aliases like ``_pow2``
