@@ -167,6 +167,44 @@ def _jit_steady_gate(tag: str, roots: tuple, before: dict, after: dict) -> dict:
     return {k: after.get(k, 0) for k in roots if k in after}
 
 
+def _transfer_steady_gate(
+    tag: str, pre1: dict, pre2: dict, post: dict, demand_ok: tuple = ()
+) -> dict:
+    """ISSUE 17 in-run gate: steady-state device↔host crossings per
+    round must be CONSTANT — the per-site crossing-count delta over the
+    last measured round must equal the round before it, or a hot path
+    has grown an unpriced boundary trip the TRANSFER lint family cannot
+    see (it proves sites are audited, not how often they fire).
+    ``pre1``/``pre2``/``post`` are ledger snapshots entering the
+    second-to-last measured round, entering the last, and after it.
+    Byte deltas may wobble with payload content; counts may not.
+    ``demand_ok`` names sites whose crossings are demand-driven cache
+    fills (the lazy digest ladder: which levels a walk touches depends
+    on WHERE the probe key landed, not how many rounds ran) — still
+    measured and reported, just not pinned. Returns the last round's
+    per-site delta — the artifact's ``transfers_per_round`` stamp."""
+    from delta_crdt_ex_tpu.utils import transfers
+
+    d_prev = transfers.delta(pre1, pre2)
+    d_last = transfers.delta(pre2, post)
+    c_prev = {s: d["count"] for s, d in d_prev.items() if s not in demand_ok}
+    c_last = {s: d["count"] for s, d in d_last.items() if s not in demand_ok}
+    assert c_prev == c_last, (
+        f"{tag}: per-round device-host crossing counts drifted in "
+        f"steady state: {c_prev} -> {c_last}"
+    )
+    return d_last
+
+
+def _transfers_snapshot() -> dict:
+    """Current ledger image for artifact stamping (next to
+    ``_topology()`` in EVERY bench artifact: absolute totals at emit
+    time, so retirement PRs carry before/after evidence by field)."""
+    from delta_crdt_ex_tpu.utils import transfers
+
+    return transfers.snapshot()
+
+
 def _jit_metrics_probe(roots: tuple) -> None:
     """Scrape a throwaway obs plane's /metrics and assert the compile
     counter is visible for the given entry roots (the ISSUE 12
@@ -642,6 +680,7 @@ def bench_durability():
         "waves": waves,
         "batch": batch,
         "topology": _topology(),
+        "transfers": _transfers_snapshot(),
     })
 
 
@@ -725,11 +764,16 @@ def bench_ingest():
 
     dts: dict[str, list[float]] = {"coalesced": [], "sequential": []}
     pre_jit: dict = {}
+    pre_tr1: dict = {}
+    pre_tr2: dict = {}
     for rnd in range(rounds + 1):  # round 0 is jit/compile warmup
+        if rnd == rounds - 1:
+            pre_tr1 = _transfers_snapshot()
         if rnd == rounds:
             # entering the LAST measured round: every shape tier the
             # steady state uses must already be compiled
             pre_jit = jitcache.compile_counts()
+            pre_tr2 = _transfers_snapshot()
         for i, s in enumerate(senders):
             for k in pools[i][rnd * keys_per_round:(rnd + 1) * keys_per_round]:
                 s.mutate("add", [k, k])
@@ -761,6 +805,10 @@ def bench_ingest():
         pre_jit, jitcache.compile_counts(),
     )
     _jit_metrics_probe(("merge_rows",))
+    # ISSUE 17 gate: per-round audited crossings are steady too
+    transfers_per_round = _transfer_steady_gate(
+        "ingest", pre_tr1, pre_tr2, _transfers_snapshot()
+    )
 
     per_round = n_senders
     rate = lambda ds: per_round / statistics.median(ds)
@@ -788,6 +836,7 @@ def bench_ingest():
         "parity": "bit_for_bit_state_checked",
         "jit_compiles": jit_counts,
         "jit_steady_state": "zero_compiles_in_last_round",
+        "transfers_per_round": transfers_per_round,
         "neighbours": n_senders,
         "rounds": rounds,
         "keys_per_round": keys_per_round,
@@ -795,6 +844,7 @@ def bench_ingest():
         "max_coalesce": max_coalesce,
         "backend": "cpu",
         "topology": _topology(),
+        "transfers": _transfers_snapshot(),
     })
 
 
@@ -856,13 +906,14 @@ def bench_tree():
                 self.msgs += 1
             return ok
 
-    def build(tag, tree):
+    def build(tag, tree, obs=None):
         transport = CountingTransport()
         clock = LogicalClock()
         reps = [
             start_link(
                 threaded=False, transport=transport, clock=clock,
                 name=f"{tag}{i}", node_id=i + 1, capacity=512,
+                obs=obs,
                 # writer tables pre-sized for the whole membership:
                 # slice writer tables flood gid knowledge through the
                 # universe, and mid-probe R-tier growth would recompile
@@ -907,7 +958,11 @@ def bench_tree():
         probe_bytes: list[int] = []
         probe_msgs: list[int] = []
         pre_jit = {}
+        pre_tr: list = []  # ledger snapshots entering the last 2 probes
+        per_peer: dict = {}  # peer addr -> [cover round per probe]
         for p in range(probes):
+            if p >= probes - 2 and tag == "tree":
+                pre_tr.append(_transfers_snapshot())
             if p == probes - 1 and tag == "tree":
                 # entering the LAST measured probe of the LAST universe:
                 # every steady-state shape must already be compiled
@@ -925,6 +980,7 @@ def bench_tree():
                     if i not in covered and r.read_keys([key]):
                         covered.add(i)
                         cover_rounds.append(rnd)
+                        per_peer.setdefault(str(r.addr), []).append(rnd)
             assert len(covered) == peers, (
                 f"{tag}: probe {p} never reached full coverage "
                 f"({len(covered)}/{peers} after {max_rounds} rounds)"
@@ -939,20 +995,33 @@ def bench_tree():
             "msgs_per_probe": probe_msgs,
             "bytes_total": sum(probe_bytes),
             "msgs_total": sum(probe_msgs),
-        }, pre_jit
+            # the hand count the lag tracer must reproduce (ISSUE 17
+            # satellite): per-peer coverage rounds, one entry per probe
+            "cover_observations": len(cover_rounds),
+            "cover_rounds_sum": sum(cover_rounds),
+            "cover_rounds_by_peer": per_peer,
+        }, pre_jit, pre_tr
 
     _stage(f"tree-gossip: {peers} peers, fanout {fanout} vs flat "
            f"{flat_neighbours}-neighbour")
+    from delta_crdt_ex_tpu.runtime.metrics import Observability
+
+    # lag tracer on the tree universe at sample_every=1: EVERY writer
+    # commit is a sample, so the crdt_propagation_rounds histogram must
+    # reproduce the hand count below exactly (ISSUE 17 satellite)
+    obs_plane = Observability(lag_sample_every=1)
     flat_t, flat_reps = build("f", tree=False)
-    tree_t, tree_reps = build("t", tree=True)
+    tree_t, tree_reps = build("t", tree=True, obs=obs_plane)
     topo = tree_reps[0]._tree_refresh()
     # the honest worst case: the writer sits at the DEEPEST tier (same
     # index writes in the flat universe)
     writer_idx = max(
         range(peers), key=lambda i: topo.tier.get(tree_reps[i].addr, 0)
     )
-    flat_stats, _ = run_probes("flat", flat_t, flat_reps, writer_idx)
-    tree_stats, pre_jit = run_probes("tree", tree_t, tree_reps, writer_idx)
+    flat_stats, _, _ = run_probes("flat", flat_t, flat_reps, writer_idx)
+    tree_stats, pre_jit, pre_tr = run_probes(
+        "tree", tree_t, tree_reps, writer_idx
+    )
 
     # ISSUE 12 gate: zero steady-state compiles on the relay merge /
     # re-emission roots across the last measured probe
@@ -961,6 +1030,56 @@ def bench_tree():
         ("merge_rows", "extract_rows", "row_apply", "winners_for_keys"),
         pre_jit, jitcache.compile_counts(),
     )
+    # ISSUE 17 gate: audited device-host crossings are steady per probe
+    # (digest-ladder fetches excepted: the lazy level cache fills on
+    # demand along whichever tree path the probe key hashed into)
+    transfers_per_probe = _transfer_steady_gate(
+        "tree", pre_tr[0], pre_tr[1], _transfers_snapshot(),
+        demand_ok=("replica.digest_levels",),
+    )
+
+    # ISSUE 17 satellite: cross-check the hand-counted propagation
+    # rounds against the dot-provenance lag tracer. Every probe commit
+    # is sampled (sample_every=1); a peer lands an observation in
+    # crdt_propagation_rounds when its applied watermark of the WRITER
+    # advances — a provenance-bearing event (walk-equality ack / entries
+    # carrying the writer's seq), which in tree gossip only the writer's
+    # direct sync partners see (relays re-emit under their own
+    # provenance). For every (writer, peer) pair the tracer covers, its
+    # observation count and round total must reproduce the hand count
+    # EXACTLY — global_round opens the writer's round before delivering
+    # to quiescence, so commit and coverage bracket the same note_round
+    # calls. A drift means the tracer's watermark events no longer see
+    # what the read_keys probe sees.
+    rounds_hist = obs_plane.lag.rounds
+    writer_addr = str(tree_reps[writer_idx].addr)
+    by_peer = tree_stats["cover_rounds_by_peer"]
+    covered_pairs = [
+        lb for lb in rounds_hist.label_sets() if lb[0] == writer_addr
+    ]
+    assert covered_pairs, (
+        "lag tracer recorded no writer-origin coverage at "
+        "sample_every=1 — the watermark events vanished"
+    )
+    tracer_count = 0
+    tracer_sum = 0.0
+    for lb in covered_pairs:
+        hand = by_peer.get(lb[1])
+        assert hand is not None, (
+            f"lag tracer observed peer {lb[1]} the hand count never saw"
+        )
+        n, s = rounds_hist.count(lb), rounds_hist.sum(lb)
+        assert n == len(hand), (
+            f"peer {lb[1]}: tracer observations {n} != hand-counted "
+            f"probes {len(hand)}"
+        )
+        assert s == float(sum(hand)), (
+            f"peer {lb[1]}: tracer propagation-round total {s} != "
+            f"hand-counted {sum(hand)} (rounds {hand})"
+        )
+        tracer_count += n
+        tracer_sum += s
+    obs_plane.close()
 
     # parity: both universes saw the same op stream — every replica
     # pair must agree canonically, bit for bit
@@ -1026,8 +1145,16 @@ def bench_tree():
         "parity": "bit_for_bit_canonical_state_checked_all_pairs",
         "jit_compiles": jit_counts,
         "jit_steady_state": "zero_compiles_in_last_probe",
+        "transfers_per_probe": transfers_per_probe,
+        "lag_tracer_cross_check": {
+            "pairs_covered": len(covered_pairs),
+            "observations": tracer_count,
+            "rounds_sum": tracer_sum,
+            "status": "exact_match_on_covered_pairs",
+        },
         "backend": "cpu",
         "topology": _topology(),
+        "transfers": _transfers_snapshot(),
     })
 
 
@@ -1323,6 +1450,7 @@ def bench_catchup():
         "link_latency_s_per_hop": LAT,
         "backend": "cpu",
         "topology": _topology(),
+        "transfers": _transfers_snapshot(),
     })
 
 
@@ -1396,11 +1524,16 @@ def bench_fleet():
 
         dts: dict[str, list[float]] = {"fleet": [], "solo": []}
         pre_jit: dict = {}
+        pre_tr1: dict = {}
+        pre_tr2: dict = {}
         for rnd in range(rounds + 1):  # round 0 is jit/compile warmup
+            if rnd == rounds - 1:
+                pre_tr1 = _transfers_snapshot()
             if rnd == rounds:
                 # entering the LAST measured round: the steady state's
                 # shape buckets must all be warm
                 pre_jit = jitcache.compile_counts()
+                pre_tr2 = _transfers_snapshot()
             base = 1_000_003 * rnd
             for i, s in enumerate(senders):
                 for j in range(keys_per_round):
@@ -1442,6 +1575,11 @@ def bench_fleet():
             ("fleet_merge_rows", "merge_rows", "row_apply"),
             pre_jit, jitcache.compile_counts(),
         )
+        # ISSUE 17 gate: per-round audited crossings steady too
+        transfers_per_round = _transfer_steady_gate(
+            f"fleet size {n}", pre_tr1, pre_tr2, _transfers_snapshot(),
+            demand_ok=("replica.digest_levels",),
+        )
 
         rate = lambda ds: n / statistics.median(ds)
         f_rate, s_rate = rate(dts["fleet"]), rate(dts["solo"])
@@ -1462,6 +1600,7 @@ def bench_fleet():
             "parity": "bit_for_bit_state_checked",
             "jit_compiles": jit_counts,
             "jit_steady_state": "zero_compiles_in_last_round",
+            "transfers_per_round": transfers_per_round,
         }
         log(
             f"fleet {n}: {f_rate:.1f} vs solo {s_rate:.1f} merges/sec "
@@ -1700,6 +1839,7 @@ def bench_fleet():
     egress_artifact = {
         "metric": "fleet_egress_member_syncs_per_sec" + ("_smoke" if SMOKE else ""),
         "topology": detected_topology(),
+        "transfers": _transfers_snapshot(),
         "unit": "member-syncs/sec",
         "stat": f"median_of_{rounds}_rounds",
         "value": egress_results[gate]["fleet_member_syncs_per_sec"],
@@ -1824,8 +1964,14 @@ def bench_fleet_mesh():
             return len(a) == len(b) and all(map(_norm_eq, a, b))
         return a == b
 
-    def run_shards(store: str, shards: int, tag: str) -> dict:
-        _stage(f"mesh fleet [{store}] shards={shards}: building {2 * n} members")
+    def run_shards(
+        store: str, shards: int, tag: str, narrow: bool = True
+    ) -> dict:
+        _stage(
+            f"mesh fleet [{store}] shards={shards}"
+            f"{'' if narrow else ' (legacy padded plane)'}: "
+            f"building {2 * n} members"
+        )
         transport = LocalTransport()
         mk = lambda nm, nid: start_link(
             AWLWWMap, threaded=False, transport=transport,
@@ -1843,7 +1989,7 @@ def bench_fleet_mesh():
             # path + the wire-parity witness)
             fm[i].set_neighbours([fm[(i + n // 2) % n], f"{tag}mr{i}"])
             vm[i].set_neighbours([vm[(i + n // 2) % n], f"{tag}vr{i}"])
-        f_mesh = Fleet(fm, mesh=fleet_mesh(shards))
+        f_mesh = Fleet(fm, mesh=fleet_mesh(shards), mesh_narrow=narrow)
         f_vmap = Fleet(vm)
 
         dts: dict[str, list[float]] = {
@@ -1856,16 +2002,22 @@ def bench_fleet_mesh():
         mesh_roots = (
             "mesh_fleet_merge_rows", "mesh_fleet_interval_slices",
             "mesh_fleet_tree_from_leaves", "mesh_fleet_own_ctr_columns",
-            "mesh_plane_rotate", "merge_rows", "row_apply",
+            "mesh_plane_rotate", "mesh_plane_exchange",
+            "merge_rows", "row_apply",
         ) if store == "binned" else (
             "mesh_fleet_hash_merge_rows", "mesh_fleet_hash_interval_slices",
             "mesh_fleet_hash_row_counts", "mesh_fleet_hash_own_delta_counts",
             "mesh_fleet_tree_from_leaves", "mesh_fleet_own_ctr_columns",
-            "mesh_plane_rotate",
+            "mesh_plane_rotate", "mesh_plane_exchange",
         )
+        pre_tr1: dict = {}
+        pre_tr2: dict = {}
         for rnd in range(rounds + 1):  # round 0 is jit/compile warmup
+            if rnd == rounds - 1:
+                pre_tr1 = _transfers_snapshot()
             if rnd == rounds:
                 pre_jit = jitcache.compile_counts()
+                pre_tr2 = _transfers_snapshot()
             base = 1_000_003 * rnd
             for i in range(n):
                 for j in range(keys_per_round):
@@ -1942,6 +2094,13 @@ def bench_fleet_mesh():
             f"mesh fleet [{store}] shards={shards}", mesh_roots,
             pre_jit, jitcache.compile_counts(),
         )
+        # ISSUE 17 gate: per-tick audited crossings steady (the ledger
+        # aggregates both twins — meshplane.* sites isolate the plane)
+        transfers_per_tick = _transfer_steady_gate(
+            f"mesh fleet [{store}] shards={shards}",
+            pre_tr1, pre_tr2, _transfers_snapshot(),
+            demand_ok=("replica.digest_levels",),
+        )
 
         rate = lambda ds: n / statistics.median(ds)
         st = f_mesh.stats()
@@ -1976,6 +2135,8 @@ def bench_fleet_mesh():
             "members_per_shard": ms["members_per_shard"],
             "jit_compiles": jit_counts,
             "jit_steady_state": "zero_compiles_in_last_round",
+            "transfers_per_tick": transfers_per_tick,
+            "plane_narrow": narrow,
             "parity": "bit_for_bit_state_wire_acks_checked",
         }
         log(
@@ -1994,6 +2155,42 @@ def bench_fleet_mesh():
     # cross-backend parity at the gate shard count
     hash_leg = run_shards("hash", shard_counts[-1], "mzh_")
 
+    # ---- ISSUE 17 retirement evidence: narrow vs legacy padded plane --
+    # Re-run the gate shard count with the padded host round-trip
+    # exchange (every leg above already proved the narrow plane's state
+    # parity against the vmap twin). The ledger delta is the claim: the
+    # narrow plane crosses the boundary ONCE per tick (dense rows,
+    # meshplane.ship_dense) where the padded plane crossed twice per
+    # exchange group with full [shards, depth, ...] buffers — strictly
+    # fewer crossings AND strictly fewer bytes, same delivered state.
+    legacy_leg = run_shards(
+        "binned", shard_counts[-1], "mzl_", narrow=False
+    )
+    plane_delta = lambda leg: {
+        s: d
+        for s, d in leg["transfers_per_tick"].items()
+        if s.startswith("meshplane.")
+    }
+    narrow_plane = plane_delta(legs[str(shard_counts[-1])])
+    legacy_plane = plane_delta(legacy_leg)
+    assert set(narrow_plane) == {"meshplane.ship_dense"}, narrow_plane
+    assert set(legacy_plane) == {
+        "meshplane.ship_padded", "meshplane.deliver_padded",
+    }, legacy_plane
+    sum_counts = lambda d: sum(v["count"] for v in d.values())
+    sum_bytes = lambda d: sum(v["bytes"] for v in d.values())
+    assert sum_counts(narrow_plane) < sum_counts(legacy_plane), (
+        narrow_plane, legacy_plane,
+    )
+    assert sum_bytes(narrow_plane) < sum_bytes(legacy_plane), (
+        narrow_plane, legacy_plane,
+    )
+    log(
+        f"plane retirement: narrow {sum_counts(narrow_plane)} crossings "
+        f"/ {sum_bytes(narrow_plane)} B per tick vs legacy "
+        f"{sum_counts(legacy_plane)} / {sum_bytes(legacy_plane)} B"
+    )
+
     # the mesh compile counter must ride the export surface too
     _jit_metrics_probe(("mesh_fleet_merge_rows", "mesh_plane_rotate"))
 
@@ -2007,11 +2204,26 @@ def bench_fleet_mesh():
         ],
         "shard_counts": legs,
         "hash_backend_gate": hash_leg,
+        "plane_retirement": {
+            "narrow_per_tick": narrow_plane,
+            "legacy_per_tick": legacy_plane,
+            "legacy_leg": legacy_leg,
+            "crossings_per_tick": {
+                "narrow": sum_counts(narrow_plane),
+                "legacy": sum_counts(legacy_plane),
+            },
+            "bytes_per_tick": {
+                "narrow": sum_bytes(narrow_plane),
+                "legacy": sum_bytes(legacy_plane),
+            },
+            "status": "narrow_strictly_lower_with_state_parity",
+        },
         "replicas": n,
         "rounds": rounds,
         "keys_per_round": keys_per_round,
         "tree_depth": depth,
         "topology": detected_topology(),
+        "transfers": _transfers_snapshot(),
         "parity": "bit_for_bit_state_wire_acks_checked",
         "backend": "cpu",
         # honest finding (the PR 8 pattern): on forced-CPU virtual
@@ -2341,6 +2553,7 @@ def bench_hashstore():
         "parity": "reads+leaf+ctx+seq (symmetric) and wal_bytes+acks (shared writer), asserted in-run",
         "backend": "cpu",
         "topology": _topology(),
+        "transfers": _transfers_snapshot(),
     })
 
 
@@ -2828,67 +3041,67 @@ def _serve_harness(tiny: bool = False) -> dict:
     rep_ovl.stop()
     plane.close()
 
-    # ---- leg F: zero steady-state compiles on the admission roots ------
+    # ---- leg F: zero steady-state compiles + pinned transfer counts ----
+    _stage("serve leg F: steady-state compile + transfer gates")
+    rep_g = start_link(
+        threaded=False, transport=LocalTransport(), name="serve_jit",
+        capacity=cap, tree_depth=depth,
+    )
+    fdg = rep_g.frontdoor(max_commit_ops=commit, max_pending_ops=1 << 30)
+    n_batches = 2 if tiny else 8
+    rounds = [
+        _serve_distinct_bucket_batches(n_batches, commit, depth, tag)
+        for tag in (1, 2, 3, 4)
+    ]
+    probe = [int(rounds[0][0][0]), int(rounds[0][0][1])]
+
+    sentinel = itertools.count(1 << 50)
+
+    def drain_round(batches, with_reads):
+        # preload whole full-size commits while the worker is
+        # blocked on the replica lock: every grouped commit then
+        # lands on exactly one (u, m=1) row_apply tier. A sentinel
+        # op parks the worker INSIDE apply_ops (on the held lock)
+        # first, so it cannot pop a partial prefix mid-preload.
+        rep_g._lock.acquire()
+        try:
+            s = next(sentinel)
+            fdg.mutate_async("add", [s, s])
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                with fdg._lock:
+                    parked = not fdg._queue and fdg._pending_ops == 1
+                if parked:
+                    break
+                time.sleep(0.001)
+            tickets = [
+                fdg.mutate_async("add", [int(k), int(k)])
+                for batch in batches
+                for k in batch
+            ]
+        finally:
+            rep_g._lock.release()
+        stop = threading.Event()
+
+        def read_loop():
+            while not stop.is_set():
+                fdg.read_keys(probe)
+
+        rt = threading.Thread(target=read_loop)
+        if with_reads:
+            rt.start()
+        t0 = time.perf_counter()
+        for tk in tickets:
+            tk.result(120)
+        dt = time.perf_counter() - t0
+        if with_reads:
+            stop.set()
+            rt.join(timeout=10)
+        return len(tickets) / dt
+
+    fdg.read_keys(probe)  # warm the read tier
+    drain_round(rounds[0], with_reads=not tiny)  # warm round
     if not tiny:
-        _stage("serve leg F: steady-state compile gate")
-        rep_g = start_link(
-            threaded=False, transport=LocalTransport(), name="serve_jit",
-            capacity=cap, tree_depth=depth,
-        )
-        fdg = rep_g.frontdoor(max_commit_ops=commit, max_pending_ops=1 << 30)
-        n_batches = 8
-        rounds = [
-            _serve_distinct_bucket_batches(n_batches, commit, depth, tag)
-            for tag in (1, 2)
-        ]
-        probe = [int(rounds[0][0][0]), int(rounds[0][0][1])]
-
-        sentinel = itertools.count(1 << 50)
-
-        def drain_round(batches, with_reads):
-            # preload whole full-size commits while the worker is
-            # blocked on the replica lock: every grouped commit then
-            # lands on exactly one (u, m=1) row_apply tier. A sentinel
-            # op parks the worker INSIDE apply_ops (on the held lock)
-            # first, so it cannot pop a partial prefix mid-preload.
-            rep_g._lock.acquire()
-            try:
-                s = next(sentinel)
-                fdg.mutate_async("add", [s, s])
-                deadline = time.monotonic() + 10
-                while time.monotonic() < deadline:
-                    with fdg._lock:
-                        parked = not fdg._queue and fdg._pending_ops == 1
-                    if parked:
-                        break
-                    time.sleep(0.001)
-                tickets = [
-                    fdg.mutate_async("add", [int(k), int(k)])
-                    for batch in batches
-                    for k in batch
-                ]
-            finally:
-                rep_g._lock.release()
-            stop = threading.Event()
-
-            def read_loop():
-                while not stop.is_set():
-                    fdg.read_keys(probe)
-
-            rt = threading.Thread(target=read_loop)
-            if with_reads:
-                rt.start()
-            t0 = time.perf_counter()
-            for tk in tickets:
-                tk.result(120)
-            dt = time.perf_counter() - t0
-            if with_reads:
-                stop.set()
-                rt.join(timeout=10)
-            return len(tickets) / dt
-
-        fdg.read_keys(probe)  # warm the read tier
-        drain_round(rounds[0], with_reads=True)  # warm round
         pre_jit = jitcache.compile_counts()
         gate_rate = drain_round(rounds[1], with_reads=True)
         jit_counts = _jit_steady_gate(
@@ -2905,7 +3118,20 @@ def _serve_harness(tiny: bool = False) -> dict:
             "drain_ops_per_sec": round(gate_rate, 1),
             "compiles": jit_counts,
         }
-        rep_g.stop()
+    # transfer pin (ISSUE 17): two aligned drain rounds with the read
+    # loop OFF — read traffic is timing-dependent (however many probes
+    # squeeze in while the drain runs), so the deterministic admission
+    # plane is what gets pinned: identical commit structure per round
+    # must cross the device boundary an identical number of times
+    pre_tr1 = _transfers_snapshot()
+    drain_round(rounds[2], with_reads=False)
+    pre_tr2 = _transfers_snapshot()
+    drain_round(rounds[3], with_reads=False)
+    res["transfers_per_round"] = _transfer_steady_gate(
+        "serve", pre_tr1, pre_tr2, _transfers_snapshot(),
+        demand_ok=("replica.digest_levels",),
+    )
+    rep_g.stop()
 
     res["gates"] = {
         "admission_speedup_min": None if tiny else 3.0,
@@ -2936,6 +3162,7 @@ def bench_serve():
         **res,
         "backend": "cpu",
         "topology": _topology(),
+        "transfers": _transfers_snapshot(),
         "utc": datetime.datetime.now(datetime.timezone.utc).isoformat(),
     }
     out_path = os.path.join(
@@ -3317,6 +3544,7 @@ def bench_obs():
         },
         "backend": "cpu",
         "topology": _topology(),
+        "transfers": _transfers_snapshot(),
         "utc": datetime.datetime.now(datetime.timezone.utc).isoformat(),
     }
     out_path = os.path.join(

@@ -101,6 +101,14 @@ from delta_crdt_ex_tpu.runtime.replica import (
     _LaneLevels,
     _StackedLevels,
 )
+from delta_crdt_ex_tpu.utils import transfers
+
+# -- audited device↔host transfer sites (crdtlint TRANSFER001) --------
+_TR_MESH_PLACE = transfers.register("fleet.mesh_place")
+_TR_DISPATCH_RESULT = transfers.register("fleet.dispatch_result")
+_TR_DISPATCH_COUNTS = transfers.register("fleet.dispatch_counts")
+_TR_OWN_CTR_COLUMNS = transfers.register("fleet.own_ctr_columns")
+_TR_EGRESS_EXTRACT = transfers.register("fleet.egress_extract")
 
 
 class _FrameCollector:
@@ -235,7 +243,13 @@ class Fleet:
     """
 
     def __init__(
-        self, replicas: list, *, min_batch: int = 2, obs=None, mesh=None
+        self,
+        replicas: list,
+        *,
+        min_batch: int = 2,
+        obs=None,
+        mesh=None,
+        mesh_narrow: bool = True,
     ):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
@@ -286,7 +300,10 @@ class Fleet:
             self._mesh = mesh
             self._mesh_shards = shards
             self._mesh_sharding = transition.replica_sharding(mesh)
-            self._mesh_plane = MeshPlane(mesh)
+            # mesh_narrow=False keeps the padded host round-trip
+            # exchange — bench.py --mesh runs it as the ledger's
+            # before-retirement leg (runtime/meshplane.py)
+            self._mesh_plane = MeshPlane(mesh, narrow=mesh_narrow)
             self._mesh_plane.assign(
                 [(r.addr, r.transport) for r in self.replicas]
             )
@@ -525,7 +542,7 @@ class Fleet:
         states += [states[0]] * (lanes - len(states))
         stacked = transition.stack_states(states)
         if self._mesh_sharding is not None:
-            stacked = jax.device_put(stacked, self._mesh_sharding)
+            stacked = _TR_MESH_PLACE.put(stacked, self._mesh_sharding)
         return stacked, key, versions
 
     def _dispatch_bucket(self, members: list) -> None:
@@ -551,9 +568,11 @@ class Fleet:
         # so the growth advisory below costs no extra device sync
         wfill = getattr(res, "max_window_fill", None)
         if wfill is not None:
-            ok, n_killed, wfill = jax.device_get((res.ok, res.n_killed, wfill))
+            ok, n_killed, wfill = _TR_DISPATCH_RESULT.get(
+                (res.ok, res.n_killed, wfill)
+            )
         else:
-            ok, n_killed = jax.device_get((res.ok, res.n_killed))
+            ok, n_killed = _TR_DISPATCH_RESULT.get((res.ok, res.n_killed))
         probe_window = getattr(stacked_in, "probe_window", 0)
         dt = time.perf_counter() - t0
         # per-row count readback is lazy and shared: one device_get for
@@ -567,7 +586,9 @@ class Fleet:
         def counts_for(lane, ins_rows=res.n_ins_row, kill_rows=res.n_kill_row):
             def fn():
                 if not counts_cell:
-                    counts_cell.append(jax.device_get((ins_rows, kill_rows)))
+                    counts_cell.append(
+                        _TR_DISPATCH_COUNTS.get((ins_rows, kill_rows))
+                    )
                 ins, kill = counts_cell[0]
                 return ins[lane], kill[lane]
 
@@ -726,13 +747,13 @@ class Fleet:
             slots[: len(items)] = [e.rep.self_slot for e in items]
             stacked_tables = transition.jit_stack_pytrees(*tables)
             if self._mesh is None:
-                cols = np.asarray(
+                cols = _TR_OWN_CTR_COLUMNS.get(
                     transition.jit_fleet_own_ctr_columns(
                         stacked_tables, jnp.asarray(slots)
                     )
                 )
             else:
-                cols = np.asarray(
+                cols = _TR_OWN_CTR_COLUMNS.get(
                     transition.jit_mesh_fleet_own_ctr_columns(
                         self._mesh, stacked_tables, jnp.asarray(slots)
                     )
@@ -977,7 +998,7 @@ class Fleet:
             sl, tiers = model.mesh_fleet_extract_rows(
                 self._mesh, stacked, jnp.asarray(rows)
             )
-        host = jax.device_get(sl)  # one transfer for the whole bucket
+        host = _TR_EGRESS_EXTRACT.get(sl)  # one transfer for the whole bucket
         for k, (_rep, _st, job) in enumerate(items):
             extracted[id(job)] = _lane_slice(
                 host, k, job.rows, None if tiers is None else tiers[k]
@@ -1113,6 +1134,10 @@ class Fleet:
                 ),
                 "dispatches": self._dispatches,
                 "batched_messages": self._batched_messages,
+                # device↔host boundary ledger (ISSUE 17): PROCESS-WIDE
+                # per-site totals (the registry is global; a fleet
+                # shares it with its members and any co-resident fleet)
+                "transfers": transfers.snapshot(),
                 "occupancy_hist": occ,
                 "avg_occupancy": (
                     round(sum(k * v for k, v in occ.items()) / total, 3)

@@ -40,17 +40,42 @@ the rotate kernel are bounded by (bucket slice geometry) × (mesh size
 − 1 shard distances) — the compile-cache audit covers the kernel via
 its ``named_jit`` registration, and ``bench.py --fleet --mesh`` gates
 zero steady-state compiles in-run.
+
+Transfer discipline (ISSUE 17, the device-resident campaign's first
+retirement): the original exchange round-tripped the FULL padded
+``[shards, depth, ...]`` buffers through the host twice per group —
+``device_put`` on ship, ``device_get`` on deliver. The narrow path
+(default) ships each group's entry rows as dense pow2-padded column
+stacks plus int32 scatter vectors in ONE audited crossing per tick
+(``meshplane.ship_dense``), scatters into the collective layout on
+device (:func:`~delta_crdt_ex_tpu.runtime.transition
+.mesh_plane_exchange`), and delivers device-resident slices of the
+rotated buffers — no ``device_get``. The padded path survives behind
+``MeshPlane(narrow=False)`` under its own audited sites
+(``meshplane.ship_padded`` / ``meshplane.deliver_padded``) so
+``bench.py --mesh`` can hold the before/after ledger delta as
+evidence; every crossing either way goes through
+:mod:`delta_crdt_ex_tpu.utils.transfers` (crdtlint TRANSFER001).
 """
 
 from __future__ import annotations
 
 import dataclasses
 
-import jax
 import numpy as np
 
 from delta_crdt_ex_tpu.models.binned import pow2_tier
 from delta_crdt_ex_tpu.runtime import sync as sync_proto, transition
+from delta_crdt_ex_tpu.utils import transfers
+
+# -- audited device↔host transfer sites (crdtlint TRANSFER001) --
+#: legacy padded path: whole [shards, depth, ...] buffers cross twice
+#: per exchange group (ship + deliver)
+_TR_SHIP_PADDED = transfers.register("meshplane.ship_padded")
+_TR_DELIVER_PADDED = transfers.register("meshplane.deliver_padded")
+#: narrow path: ONE crossing per tick — dense entry-row stacks plus
+#: scatter index vectors; nothing comes back (delivery stays resident)
+_TR_SHIP_DENSE = transfers.register("meshplane.ship_dense")
 
 #: EntriesMsg columns that ride the device exchange; ``rows`` stays host
 #: control metadata, mirroring ``Replica._slice_arrays`` where the row
@@ -68,12 +93,18 @@ class MeshPlane:
     whose ``send`` is handed to the members' emission tails in place
     of the frame collector's."""
 
-    __slots__ = ("mesh", "shards", "sharding", "_members")
+    __slots__ = ("mesh", "shards", "sharding", "narrow", "_members")
 
-    def __init__(self, mesh) -> None:
+    def __init__(self, mesh, *, narrow: bool = True) -> None:
         self.mesh = mesh
         self.shards = int(mesh.devices.size)
         self.sharding = transition.replica_sharding(mesh)
+        #: narrow exchange (default): ship dense entry rows once per
+        #: tick, scatter into the padded collective layout on device,
+        #: and deliver device-resident slices — no device_get at all.
+        #: ``narrow=False`` keeps the padded host round-trip path (the
+        #: ledger prices both; bench.py --mesh compares them).
+        self.narrow = bool(narrow)
         self._members: dict = {}  # addr -> (shard, transport)
 
     def assign(self, members: list) -> None:
@@ -175,22 +206,30 @@ class _TickExchange:
             groups.setdefault((shift, geom), []).append((idx, src, dst, a))
         return groups, same_shard
 
-    def flush(self) -> dict:
-        """Run the rotation collectives, then deliver every buffered
-        message in global send order. Returns the tick's stats."""
-        groups, same_shard = self._exchange_groups()
-        delivered_cols: dict = {}  # entry idx -> exchanged column dict
+    @staticmethod
+    def _slot_layout(items):
+        """Per-entry slot index within its source shard's buffer rows,
+        plus the pow2 depth tier covering the busiest source."""
+        slot_of: list = []
+        per_src: dict = {}
+        for _idx, src, _dst, _a in items:
+            j = per_src.get(src, 0)
+            per_src[src] = j + 1
+            slot_of.append(j)
+        return slot_of, pow2_tier(max(per_src.values()))
+
+    def _exchange_padded(self, groups):
+        """Legacy padded exchange: per group, materialise the full
+        ``[shards, depth, ...]`` buffers on the host, ship them, rotate,
+        and fetch the whole rotated stack back — two audited crossings
+        per group, each moving ``shards × depth`` padded rows for the
+        handful the tick actually delivers."""
+        delivered_cols: dict = {}
         permuted_bytes = 0
         exchanges = 0
         shards = self.plane.shards
         for (shift, geom), items in groups.items():
-            slot_of: list = []
-            per_src: dict = {}
-            for _idx, src, _dst, _a in items:
-                j = per_src.get(src, 0)
-                per_src[src] = j + 1
-                slot_of.append(j)
-            depth = pow2_tier(max(per_src.values()))
+            slot_of, depth = self._slot_layout(items)
             bufs = {
                 c: np.zeros((shards, depth) + shape, np.dtype(dt))
                 for c, shape, dt in geom
@@ -198,17 +237,88 @@ class _TickExchange:
             for (idx, src, _dst, a), j in zip(items, slot_of):
                 for c in _EXCHANGE_COLS:
                     bufs[c][src, j] = a[c]
-            shipped = jax.device_put(bufs, self.plane.sharding)
+            shipped = _TR_SHIP_PADDED.put(bufs, self.plane.sharding)
             rotated = transition.jit_mesh_plane_rotate(
                 self.plane.mesh, shift, shipped
             )
-            host = jax.device_get(rotated)
+            host = _TR_DELIVER_PADDED.get(rotated)
             permuted_bytes += sum(b.nbytes for b in bufs.values())
             exchanges += 1
             for (idx, _src, dst, _a), j in zip(items, slot_of):
                 delivered_cols[idx] = {
                     c: host[c][dst, j] for c in _EXCHANGE_COLS
                 }
+        return delivered_cols, permuted_bytes, exchanges
+
+    def _exchange_narrow(self, groups):
+        """Narrow exchange (the first ledger retirement): stage every
+        group's entry rows as DENSE column stacks (pow2-padded on the
+        entry axis) with int32 ``src``/``slot`` scatter vectors, ship
+        the whole tick in ONE audited crossing, and let
+        ``jit_mesh_plane_exchange`` scatter into the padded collective
+        layout on device (pad rows carry ``src == shards`` and drop out
+        of the scatter) before the same rotation. Nothing returns to
+        the host: delivery hands out device-resident slices of the
+        rotated buffers, which the receivers' device-plane body path
+        already consumes. ``permuted_bytes`` keeps its meaning — the
+        padded collective payload — computed analytically."""
+        delivered_cols: dict = {}
+        permuted_bytes = 0
+        if not groups:
+            return delivered_cols, 0, 0
+        shards = self.plane.shards
+        staged: list = []  # (items, slot_of, shift, depth) per group
+        bundle: list = []  # matching {"cols", "src", "slot"} stacks
+        for (shift, geom), items in groups.items():
+            slot_of, depth = self._slot_layout(items)
+            n_pad = pow2_tier(len(items))
+            cols = {
+                c: np.zeros((n_pad,) + shape, np.dtype(dt))
+                for c, shape, dt in geom
+            }
+            # pad rows scatter out of range and are dropped on device
+            src = np.full((n_pad,), shards, np.int32)
+            slot = np.zeros((n_pad,), np.int32)
+            for k, ((idx, s, _dst, a), j) in enumerate(
+                zip(items, slot_of)
+            ):
+                for c in _EXCHANGE_COLS:
+                    cols[c][k] = a[c]
+                src[k] = s
+                slot[k] = j
+            bundle.append({"cols": cols, "src": src, "slot": slot})
+            staged.append((items, slot_of, shift, depth))
+            row_bytes = sum(
+                np.dtype(dt).itemsize * int(np.prod(shape, dtype=np.int64))
+                for _c, shape, dt in geom
+            )
+            permuted_bytes += shards * depth * row_bytes
+        shipped = _TR_SHIP_DENSE.put(bundle)
+        for g, (items, slot_of, shift, depth) in enumerate(staged):
+            rotated = transition.jit_mesh_plane_exchange(
+                self.plane.mesh,
+                shift,
+                depth,
+                shipped[g]["cols"],
+                shipped[g]["src"],
+                shipped[g]["slot"],
+            )
+            for (idx, _src, dst, _a), j in zip(items, slot_of):
+                delivered_cols[idx] = {
+                    c: rotated[c][dst, j] for c in _EXCHANGE_COLS
+                }
+        return delivered_cols, permuted_bytes, len(staged)
+
+    def flush(self) -> dict:
+        """Run the rotation collectives, then deliver every buffered
+        message in global send order. Returns the tick's stats."""
+        groups, same_shard = self._exchange_groups()
+        exchange = (
+            self._exchange_narrow
+            if self.plane.narrow
+            else self._exchange_padded
+        )
+        delivered_cols, permuted_bytes, exchanges = exchange(groups)
 
         intra_entries = same_shard + len(delivered_cols)
         members = self.plane._members

@@ -688,6 +688,18 @@ class MetricsBridge:
             "XLA compiles per named jit entry root (tracing-cache size)",
             ("name",),
         )
+        # same monotone-gauge-as-_total convention: the transfer ledger
+        # audit reports absolute per-site crossing/byte totals
+        self.transfers = g(
+            "crdt_transfers_total",
+            "Device-host crossings per audited transfer site",
+            ("site",),
+        )
+        self.transfer_bytes = g(
+            "crdt_transfer_bytes_total",
+            "Bytes moved across the device-host boundary per audited site",
+            ("site",),
+        )
         # batchable handlers for the two per-message hot families: the
         # grouped ingest path emits them via telemetry.execute_many, and
         # the batch form folds the whole group under ONE registry-lock
@@ -720,6 +732,7 @@ class MetricsBridge:
             (telemetry.FLEET_EGRESS, self._on_fleet_egress),
             (telemetry.MESH_EXCHANGE, self._on_mesh_exchange),
             (telemetry.JIT_COMPILE, self._on_jit_compile),
+            (telemetry.TRANSFER, self._on_transfer),
             (telemetry.SERVE_ADMIT, self._on_serve_admit),
             (telemetry.SERVE_SHED, self._on_serve_shed),
             (telemetry.SERVE_READ, self._on_serve_read),
@@ -888,6 +901,12 @@ class MetricsBridge:
         lb = (self._s(meta.get("name")),)
         with self._lock:
             self.jit_compiles._set_held(lb, meas.get("compiles", 0))
+
+    def _on_transfer(self, _event, meas, meta) -> None:
+        lb = (self._s(meta.get("site")),)
+        with self._lock:
+            self.transfers._set_held(lb, meas.get("crossings", 0))
+            self.transfer_bytes._set_held(lb, meas.get("bytes", 0))
 
     def _on_serve_admit(self, _event, meas, meta) -> None:
         lb = (self._s(meta.get("name")),)
@@ -1281,6 +1300,19 @@ class Observability:
         self._jit_collector = _collect_jit_compiles
         self.registry.register_collector(_collect_jit_compiles)
         self.add_varz_source("jitcache", _jitcache.varz)
+        # transfer-ledger audit (ISSUE 17): same collector-fed shape —
+        # each scrape re-publishes every audited site's absolute
+        # crossing/byte totals through TRANSFER telemetry; the bridge
+        # folds them into crdt_transfers_total{site=...} /
+        # crdt_transfer_bytes_total{site=...}
+        from delta_crdt_ex_tpu.utils import transfers as _transfers
+
+        def _collect_transfers() -> None:
+            _transfers.audit()
+
+        self._transfer_collector = _collect_transfers
+        self.registry.register_collector(_collect_transfers)
+        self.add_varz_source("transfers", _transfers.varz)
         self._c_drained = self.registry.counter(
             "crdt_drained_messages_total",
             "Messages drained by the replica event loop", ("name",),
@@ -1521,6 +1553,8 @@ class Observability:
         # must not keep running the compile-cache audit at scrape time
         self.registry.unregister_collector(self._jit_collector)
         self.remove_source("jitcache")
+        self.registry.unregister_collector(self._transfer_collector)
+        self.remove_source("transfers")
         with self._lock:
             server, self._server = self._server, None
         if server is not None:

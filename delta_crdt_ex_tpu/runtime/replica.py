@@ -71,10 +71,35 @@ from delta_crdt_ex_tpu.runtime.transport import (
     forward_fleet_entries,
 )
 from delta_crdt_ex_tpu.runtime.wal import ReplayClock, WalLog
+from delta_crdt_ex_tpu.utils import transfers
 
 logger = logging.getLogger("delta_crdt_ex_tpu")
 
 _SLICE_COLUMNS = ("key", "valh", "ts", "node", "ctr", "alive")
+
+# -- audited device↔host transfer sites (crdtlint TRANSFER001) --------
+# Every crossing on the replica paths goes through one of these, so the
+# ledger (utils/transfers) prices each boundary and the bench gates can
+# pin steady-state per-round crossing counts. Labels are the ledger /
+# crdt_transfers_total{site=...} keys — rename = dashboard break.
+_TR_DIGEST_LEVELS = transfers.register("replica.digest_levels")
+_TR_STATE_PLACE = transfers.register("replica.state_place")
+_TR_SNAPSHOT = transfers.register("replica.snapshot")
+_TR_READ_KEYS = transfers.register("replica.read_keys")
+_TR_APPLY_COUNTS = transfers.register("replica.apply_counts")
+_TR_INGEST_COUNTS = transfers.register("replica.ingest_counts")
+_TR_DIFF_WINNERS = transfers.register("replica.diff_winners")
+_TR_WINNER_ALL = transfers.register("replica.winner_all")
+_TR_WINNER_ROWS = transfers.register("replica.winner_rows")
+_TR_CANONICAL_STATE = transfers.register("replica.canonical_state")
+_TR_OWN_CTR_CACHE = transfers.register("replica.own_ctr_cache")
+_TR_RELAY_ACCOUNTING = transfers.register("replica.relay_accounting")
+_TR_SLICE_PAYLOAD_DOTS = transfers.register("replica.slice_payload_dots")
+_TR_SLICE_WIRE = transfers.register("replica.slice_wire")
+_TR_SLICE_PLACE = transfers.register("replica.slice_place")
+_TR_WAL_ENTRIES = transfers.register("replica.wal_entries")
+_TR_GC_SCAN = transfers.register("replica.gc_scan")
+_TR_DRAIN_ACCOUNTING = transfers.register("replica.drain_accounting")
 
 
 def _pow2(n: int, floor: int = 8) -> int:
@@ -110,7 +135,9 @@ class _LazyLevels:
     def __getitem__(self, level: int) -> np.ndarray:
         h = self._host[level]
         if h is None:
-            h = self._host[level] = np.asarray(self._dev[level])
+            h = self._host[level] = np.asarray(
+                _TR_DIGEST_LEVELS.get(self._dev[level])
+            )
         return h
 
 
@@ -139,14 +166,16 @@ class _StackedLevels:
         want = [j for j in range(upto + 1) if self._host[j] is None]
         if not want:
             return
-        got = jax.device_get([self._dev[j] for j in want])
+        got = _TR_DIGEST_LEVELS.get([self._dev[j] for j in want])
         for j, arr in zip(want, got):
             self._host[j] = np.asarray(arr)
 
     def lane_level(self, level: int, lane: int) -> np.ndarray:
         h = self._host[level]
         if h is None:
-            h = self._host[level] = np.asarray(self._dev[level])
+            h = self._host[level] = np.asarray(
+                _TR_DIGEST_LEVELS.get(self._dev[level])
+            )
         return h[lane]
 
 
@@ -603,7 +632,7 @@ class Replica:
         if device is not None:
             # commit the state to the device: every jitted kernel over it
             # then runs (and allocates its outputs) there
-            self.state = jax.device_put(self.state, device)
+            self.state = _TR_STATE_PLACE.put(self.state, device)
         if wal_records:
             # snapshot + replay: records past the snapshot's sequence
             # number re-apply through the normal idempotent flush/merge
@@ -738,8 +767,14 @@ class Replica:
 
     def _snapshot(self) -> Snapshot:
         state = self.state
-        arrays = {c: np.asarray(getattr(state, c)) for c in self._store_columns()}
+        # contractual crossing: durability serialises on host by design
+        # — one audited batched fetch of the full column set
+        host = _TR_SNAPSHOT.get({c: getattr(state, c) for c in self._store_columns()})
+        # column order, not device_get's sorted pytree order: snapshot
+        # bytes are a durability format
+        arrays = {c: np.asarray(host[c]) for c in self._store_columns()}
         for m in self._store_meta():
+            # crdtlint: allow[TRANSFER001] STORE_META fields are static Python ints on the store pytree, not device scalars
             arrays[m] = int(getattr(state, m))
         return Snapshot(
             node_id=self.node_id,
@@ -758,6 +793,21 @@ class Replica:
             # the write must capture state under the lock, and callers opted
             # into blocking-on-durability per mutation
             self.storage_module.write(self.name, self._snapshot())
+
+    def _wal_arrays_host(self, a: dict) -> dict:
+        """Host numpy image of an EntriesMsg column dict for a WAL
+        record. Durability is host-side by definition, so a
+        device-plane slice is copied back exactly ONCE here — the
+        contractual crossing the ledger prices under
+        ``replica.wal_entries``; host-plane images pass through with no
+        crossing counted."""
+        if isinstance(a["key"], np.ndarray):
+            return {c: np.asarray(v) for c, v in a.items()}
+        # rebuild in the message's column order: device_get flattens the
+        # dict as a pytree and hands back SORTED keys, and a WAL record
+        # pickles dict insertion order into its bytes
+        got = _TR_WAL_ENTRIES.get(a)
+        return {c: np.asarray(got[c]) for c in a}
 
     def _durable(self, record_fn: Callable[[], dict]) -> None:
         """One durability point per applied batch/slice. With a WAL this
@@ -972,7 +1022,7 @@ class Replica:
             # merge (a full-store copy per dispatch)
             lambda ins=res.n_inserted, kill=res.n_killed: (ins, kill)
         )
-        self._gc_pressure += len(rec["payloads"]) + int(res.n_killed)
+        self._gc_pressure += len(rec["payloads"]) + int(_TR_INGEST_COUNTS.get(res.n_killed))
         self._maybe_gc()
 
     def checkpoint(self) -> None:
@@ -1099,9 +1149,8 @@ class Replica:
             arr = np.zeros(k, np.uint64)
             arr[: len(hashes)] = hashes
             w = self.model.winners_for_keys(self.state, jnp.asarray(arr))
-            found = np.asarray(w.found)
-            gid = np.asarray(w.gid)
-            ctr = np.asarray(w.ctr)
+            # one audited batched fetch instead of three implicit ones
+            found, gid, ctr = _TR_READ_KEYS.get((w.found, w.gid, w.ctr))
             out = {}
             mask = self.num_buckets - 1
             for i, term in enumerate(key_terms):
@@ -1390,11 +1439,13 @@ class Replica:
         # rows that lost a pre-batch entry (removes AND overwriting adds)
         # cannot converge via the interval push alone — stamp them for the
         # full-row push leg
-        killed_mask = np.asarray(res.row_killed)
+        killed_mask, ctr_assigned, n_keys_changed = _TR_APPLY_COUNTS.get(
+            (res.row_killed, res.ctr_assigned, res.n_keys_changed)
+        )
         self._stamp_rows(g.rows[killed_mask & (g.rows >= 0)])
         urow, cols = g.index
-        ctr_out[:] = np.asarray(res.ctr_assigned)[urow, cols]
-        return int(res.n_keys_changed)
+        ctr_out[:] = ctr_assigned[urow, cols]
+        return int(n_keys_changed)
 
     def _stamp_rows(self, rows: np.ndarray) -> None:
         """Mark rows as needing a full-row push, each with a UNIQUE
@@ -1467,11 +1518,9 @@ class Replica:
         tkeys = np.zeros(_wire(max(len(touched), 1)), np.uint64)
         tkeys[: len(touched)] = list(touched.keys())
         w = self.model.winners_for_keys(self.state, jnp.asarray(tkeys))
-        found = np.asarray(w.found)
-        gid = np.asarray(w.gid)
-        ctr = np.asarray(w.ctr)
-        valh = np.asarray(w.valh)
-        ts = np.asarray(w.ts)
+        found, gid, ctr, valh, ts = _TR_DIFF_WINNERS.get(
+            (w.found, w.gid, w.ctr, w.valh, w.ts)
+        )
         out = {}
         for i, kh in enumerate(touched):
             if found[i]:
@@ -1490,7 +1539,7 @@ class Replica:
             # whole map: one full-table device pass (no row gather), one
             # batched device→host transfer, one nonzero + 5 flat gathers
             w = self.model.winner_all(self.state)
-            win, key, gid, ctr, valh, ts = jax.device_get(w)
+            win, key, gid, ctr, valh, ts = _TR_WINNER_ALL.get(w)
             u_idx, b_idx = np.nonzero(win)
             return tuple(
                 a[u_idx, b_idx] for a in (key, gid, ctr, valh, ts)
@@ -1502,13 +1551,12 @@ class Replica:
             padded = np.full(_pow2(len(chunk)), -1, np.int32)  # constant-shape chunk: exact tier
             padded[: len(chunk)] = chunk
             w = self.model.winner_rows(self.state, jnp.asarray(padded))
-            win = np.asarray(w.win)
+            win, key, gid, ctr, valh, ts = _TR_WINNER_ROWS.get(
+                (w.win, w.key, w.gid, w.ctr, w.valh, w.ts)
+            )
             u_idx, b_idx = np.nonzero(win)
             cols.append(
-                tuple(
-                    np.asarray(a)[u_idx, b_idx]
-                    for a in (w.key, w.gid, w.ctr, w.valh, w.ts)
-                )
+                tuple(a[u_idx, b_idx] for a in (key, gid, ctr, valh, ts))
             )
         if not cols:  # empty rows (e.g. an all-padding EntriesMsg)
             return (
@@ -1554,8 +1602,7 @@ class Replica:
                 1,
             )
             st = self.state
-            gids = np.asarray(st.ctx_gid)
-            ctx = np.asarray(st.ctx_max)
+            gids, ctx = _TR_CANONICAL_STATE.get((st.ctx_gid, st.ctx_max))
             # writers with an all-zero context column are arrival
             # artifacts (a slice's first-appearance-unioned writer table
             # registers its SOURCE's gid even when no dot of that writer
@@ -1819,7 +1866,9 @@ class Replica:
         if not self.eager_deltas:
             return jobs
         if self._own_ctr_cache is None:
-            self._own_ctr_cache = np.asarray(self.state.ctx_max[:, self.self_slot])
+            self._own_ctr_cache = _TR_OWN_CTR_CACHE.get(
+                self.state.ctx_max[:, self.self_slot]
+            )
         own = self._own_ctr_cache
         limit = int(min(self.max_sync_size, self.num_buckets))
 
@@ -2120,7 +2169,7 @@ class Replica:
         if not defer:
             return
         links = topo.links(self.addr)
-        fetched = jax.device_get([fn() for _m, fn, _o in defer])
+        fetched = _TR_RELAY_ACCOUNTING.get([fn() for _m, fn, _o in defer])
         for (metas, _fn, offsets), data in zip(defer, fetched):
             changed = self._relay_changed_per_msg(data, offsets, len(metas))
             for (frm, rows, nbytes), n_changed in zip(metas, changed):
@@ -2458,7 +2507,7 @@ class Replica:
         beats per-entry scalar indexing ~10x on big slices (VERDICT r2
         weak #4); ``device_get`` on the tuple starts all four copies
         before blocking — one device sync per slice."""
-        node_h, ctr_h, alive_h, gid_h = jax.device_get(
+        node_h, ctr_h, alive_h, gid_h = _TR_SLICE_PAYLOAD_DOTS.get(
             (sl.node, sl.ctr, sl.alive, sl.ctx_gid)
         )
         u_idx, b_idx = np.nonzero(alive_h)
@@ -2485,9 +2534,14 @@ class Replica:
         cols = {c: getattr(sl, c) for c in _SLICE_COLUMNS}
         cols["ctx_rows"], cols["ctx_lo"], cols["ctx_gid"] = sl.ctx_rows, sl.ctx_lo, sl.ctx_gid
         if target_device is None:
-            arrays = {c: host[c] if c in host else np.asarray(v) for c, v in cols.items()}
+            # one audited batched fetch of the columns the payload pass
+            # did not already host-copy (key order preserved)
+            got = _TR_SLICE_WIRE.get(
+                {c: v for c, v in cols.items() if c not in host}
+            )
+            arrays = {c: host[c] if c in host else got[c] for c in cols}
         else:
-            arrays = jax.device_put(cols, target_device)
+            arrays = _TR_SLICE_PLACE.put(cols, target_device)
         arrays["rows"] = rows  # row indices are control metadata: numpy
         return arrays
 
@@ -2664,10 +2718,7 @@ class Replica:
             lambda: {
                 "kind": "entries",
                 "seq": self._seq,
-                # host-plane numpy image: a device-plane slice is copied
-                # back once here — durability is host-side by definition
-                # (bucket indices already ride in arrays["rows"])
-                "arrays": {c: np.asarray(v) for c, v in a.items()},
+                "arrays": self._wal_arrays_host(a),
                 "payloads": dict(msg.payloads),
             }
         )
@@ -2677,7 +2728,7 @@ class Replica:
         # count too or the dict sits at peak size until enough inserts
         # arrive. (Runs only after the merge: pruning between the payload
         # update and the merge would drop dots about to become alive.)
-        self._gc_pressure += len(msg.payloads) + int(res.n_killed)
+        self._gc_pressure += len(msg.payloads) + int(_TR_INGEST_COUNTS.get(res.n_killed))
         self._maybe_gc()
 
     def _register_slice_payloads(self, payloads: dict) -> None:
@@ -3250,13 +3301,14 @@ class Replica:
                 telemetry.INGEST_COALESCE,
                 {
                     "depth": depth,
+                    # crdtlint: allow[TRANSFER001] offsets is the host list of (lo, hi) member row ranges from combine_entry_arrays, not a device array
                     "rows": int(offsets[-1][1]),
                     "entries": sum(len(m.payloads) for m in msgs),
                     "duration_s": dt,
                 },
                 {"name": self.name},
             )
-        self._gc_pressure += sum(len(m.payloads) for m in msgs) + int(res.n_killed)
+        self._gc_pressure += sum(len(m.payloads) for m in msgs) + int(_TR_INGEST_COUNTS.get(res.n_killed))
         self._maybe_gc()
 
     def _commit_entries_group(self, msgs: list, offsets, counts_fn, dt: float) -> None:
@@ -3341,7 +3393,7 @@ class Replica:
                 lambda a=a, payloads=payloads: {
                     "kind": "entries",
                     "seq": self._seq,
-                    "arrays": {c: np.asarray(v) for c, v in a.items()},
+                    "arrays": self._wal_arrays_host(a),
                     "payloads": dict(payloads),
                 }
             )
@@ -3540,12 +3592,17 @@ class Replica:
             # function of its key, so derive it instead of reading the
             # binned row index — the same pass serves the [L, B] rows
             # and the flat hash table
-            alive = np.asarray(self.state.alive)
+            st = self.state
+            # one audited batched fetch of the five scan columns (the
+            # host indexing below is unchanged — bit-identical result)
+            alive, node_h, gid_h, ctr_h, key_h = _TR_GC_SCAN.get(
+                (st.alive, st.node, st.ctx_gid, st.ctr, st.key)
+            )
             idx = np.nonzero(alive)
-            node_sel = np.asarray(self.state.node)[idx]
-            gid_l = np.asarray(self.state.ctx_gid)[node_sel].tolist()
-            ctr_l = np.asarray(self.state.ctr)[idx].tolist()
-            keys = np.asarray(self.state.key)[idx]
+            node_sel = node_h[idx]
+            gid_l = gid_h[node_sel].tolist()
+            ctr_l = ctr_h[idx].tolist()
+            keys = key_h[idx]
             bucket = (keys & np.uint64(self.num_buckets - 1)).astype(np.int64)
             live = set(zip(gid_l, bucket.tolist(), ctr_l))
             self._payloads = {d: p for d, p in self._payloads.items() if d in live}
@@ -3637,7 +3694,7 @@ class Replica:
                 if deferred:
                     # ONE transfer for every parked accounting pytree
                     # (device_get passes already-host values through)
-                    fetched = jax.device_get([f() for f, _e in deferred])
+                    fetched = _TR_DRAIN_ACCOUNTING.get([f() for f, _e in deferred])
                     for (_f, emit), data in zip(deferred, fetched):
                         emit(data)
         if obs is not None and n:
@@ -3738,6 +3795,11 @@ class Replica:
                     "in_flight": len(self._catchup),
                     "last_duration_s": round(self._catchup_last_duration, 6),
                 },
+                # device↔host boundary ledger (ISSUE 17): PROCESS-WIDE
+                # absolute per-site crossing/byte totals, not this
+                # replica's share — the ledger registry is global, like
+                # the jitcache audit it mirrors
+                "transfers": transfers.snapshot(),
                 "wal": None,
             }
             if self.tree_gossip:

@@ -68,7 +68,12 @@ import numpy as np
 
 from delta_crdt_ex_tpu.models.binned import pow4_tier
 from delta_crdt_ex_tpu.runtime import telemetry, transition
+from delta_crdt_ex_tpu.utils import transfers
 from delta_crdt_ex_tpu.utils.hashing import key_hash64
+
+# -- audited device↔host transfer sites (crdtlint TRANSFER001) --------
+_TR_WINNER_COLUMNS = transfers.register("serve.winner_columns")
+_TR_READ_KEYS = transfers.register("serve.read_keys")
 
 
 class Overloaded(RuntimeError):
@@ -99,7 +104,7 @@ def _winner_columns(model, store) -> tuple:
     ``Replica._winner_arrays_rows(None)``: one full-table device pass,
     one batched transfer, one nonzero + flat gathers."""
     w = model.winner_all(store)
-    win, key, gid, ctr, _valh, ts = jax.device_get(w)
+    win, key, gid, ctr, _valh, ts = _TR_WINNER_COLUMNS.get(w)
     u_idx, b_idx = np.nonzero(win)
     return tuple(a[u_idx, b_idx] for a in (key, gid, ctr, ts))
 
@@ -134,7 +139,7 @@ class ReadSnapshot:
         arr = np.zeros(k, np.uint64)
         arr[: len(hashes)] = hashes
         w = self.model.winners_for_keys(self.store, arr)
-        found, gid, ctr = jax.device_get((w.found, w.gid, w.ctr))
+        found, gid, ctr = _TR_READ_KEYS.get((w.found, w.gid, w.ctr))
         out = {}
         mask = self.num_buckets - 1
         pay = self._payloads

@@ -343,6 +343,28 @@ def mesh_fleet_hash_interval_slices(
     )(states, rows, self_slots, gid_selfs, lo)
 
 
+def mesh_plane_exchange(mesh, shift: int, depth: int, cols, src, slot):
+    """Fused dense-scatter + rotation for the narrow delivery plane
+    (ISSUE 17): ``cols`` holds one exchange group's entry rows as dense
+    pow2-padded column stacks, ``src``/``slot`` the int32 position of
+    each row in the padded ``[shards, depth, ...]`` collective layout.
+    The scatter builds that layout ON DEVICE — pad rows carry
+    ``src == shards`` and drop out of the scatter (``mode="drop"``) —
+    then the buffers ride the same :func:`mesh_plane_rotate`
+    ``ppermute``. The host never materialises the padded buffers and
+    the rotated result stays device-resident for delivery, which is
+    what retires the exchange's ``device_get`` from the transfer
+    ledger."""
+    shards = mesh.devices.size
+    bufs = {
+        c: jax.numpy.zeros((shards, depth) + a.shape[1:], a.dtype)
+        .at[src, slot]
+        .set(a, mode="drop")
+        for c, a in cols.items()
+    }
+    return mesh_plane_rotate(mesh, shift, bufs)
+
+
 def mesh_plane_rotate(mesh, shift: int, buffers):
     """The intra-mesh delivery plane's collective (ISSUE 13): rotate
     every leaf of ``buffers`` (padded ``[shards, depth, ...]`` slice
@@ -398,6 +420,9 @@ jit_mesh_fleet_hash_interval_slices = named_jit(
 )
 jit_mesh_plane_rotate = named_jit(
     mesh_plane_rotate, static_argnames=("mesh", "shift")
+)
+jit_mesh_plane_exchange = named_jit(
+    mesh_plane_exchange, static_argnames=("mesh", "shift", "depth")
 )
 
 

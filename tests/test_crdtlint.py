@@ -3221,7 +3221,9 @@ def test_mutation_rewidened_fleet_counts_factory_is_caught():
         "        def counts_for(lane, ins_rows=res.n_ins_row, kill_rows=res.n_kill_row):\n"
         "            def fn():\n"
         "                if not counts_cell:\n"
-        "                    counts_cell.append(jax.device_get((ins_rows, kill_rows)))"
+        "                    counts_cell.append(\n"
+        "                        _TR_DISPATCH_COUNTS.get((ins_rows, kill_rows))\n"
+        "                    )"
     )
     assert old in (REPO_ROOT / rel).read_text()
     new = _overlay_lint(
@@ -3230,7 +3232,9 @@ def test_mutation_rewidened_fleet_counts_factory_is_caught():
             "        def counts_for(lane):\n"
             "            def fn():\n"
             "                if not counts_cell:\n"
-            "                    counts_cell.append(jax.device_get((res.n_ins_row, res.n_kill_row)))"
+            "                    counts_cell.append(\n"
+            "                        _TR_DISPATCH_COUNTS.get((res.n_ins_row, res.n_kill_row))\n"
+            "                    )"
         )),
     )
     assert any(
@@ -3350,3 +3354,216 @@ def test_mutation_relay_closure_capturing_slice_is_caught():
     assert any(
         f.rule == "LEAK001" and "_relay_flush" in f.message for f in new
     ), "\n".join(f.render() for f in new)
+
+
+# ----------------------------------------------------------------------
+# TRANSFER001/TRANSFER002 — device↔host transfer-boundary audit
+
+
+TRANSFER_HOT = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from fixpkg.utils import transfers
+
+    _TR_PROBE = transfers.register("replica.probe")
+
+
+    def sink(y):
+        # keeps the probe handle non-ghost regardless of the body under
+        # test — the fixtures probe TRANSFER001 one crossing at a time
+        return _TR_PROBE.get(y)
+
+
+    def ship(x):
+        dev = jnp.zeros((4,))
+        {body}
+"""
+
+
+_TRANSFER_CASE = iter(range(1 << 20))
+
+
+def _transfer_lint(tmp_path, body: str) -> list:
+    pkg = make_pkg(
+        tmp_path / f"case{next(_TRANSFER_CASE)}",
+        {"runtime/replica.py": TRANSFER_HOT.format(body=body)},
+    )
+    return [f for f in lint(pkg) if f.rule.startswith("TRANSFER")]
+
+
+def test_transfer_raw_device_get_flagged_audited_site_clean(tmp_path):
+    new = _transfer_lint(tmp_path, "return jax.device_get(dev)")
+    assert rules_of(new) == {"TRANSFER001"}
+    assert "route the crossing through an audited transfer site" in new[0].message
+    new = _transfer_lint(tmp_path, "return _TR_PROBE.get(dev)")
+    assert new == []
+
+
+def test_transfer_raw_device_put_flagged_audited_put_clean(tmp_path):
+    new = _transfer_lint(tmp_path, "return jax.device_put(np.asarray(x))")
+    assert rules_of(new) == {"TRANSFER001"}
+    new = _transfer_lint(tmp_path, "return _TR_PROBE.put(np.asarray(x))")
+    assert new == []
+
+
+def test_transfer_np_asarray_on_device_value_flagged_host_clean(tmp_path):
+    new = _transfer_lint(tmp_path, "return np.asarray(dev)")
+    assert rules_of(new) == {"TRANSFER001"}
+    assert "unaudited crossing" in new[0].message
+    # np.asarray of a host value is host work, not a crossing
+    new = _transfer_lint(tmp_path, "return np.asarray([1, 2, 3])")
+    assert new == []
+    # the audited helper form is the counted path
+    new = _transfer_lint(
+        tmp_path, "return transfers.audited_get(dev, _TR_PROBE)"
+    )
+    assert new == []
+
+
+def test_transfer_item_int_and_iteration_flagged_static_shape_clean(tmp_path):
+    new = _transfer_lint(tmp_path, "return dev.item()")
+    assert rules_of(new) == {"TRANSFER001"}
+    new = _transfer_lint(tmp_path, "return int(dev[0])")
+    assert rules_of(new) == {"TRANSFER001"}
+    new = _transfer_lint(
+        tmp_path, "return [int(v) for v in dev]"
+    )
+    assert rules_of(new) == {"TRANSFER001"}
+    # static shape arithmetic is host metadata, not a crossing
+    new = _transfer_lint(tmp_path, "return int(dev.shape[0]) * 2")
+    assert new == []
+
+
+def test_transfer_taint_propagates_and_dies_at_audited_get(tmp_path):
+    """Taint flows through assignment chains; an audited fetch kills it,
+    so downstream host numpy on the fetched copy stays green."""
+    new = _transfer_lint(
+        tmp_path,
+        "mid = dev * 2\n"
+        "        other = mid\n"
+        "        return other.tolist()",
+    )
+    assert rules_of(new) == {"TRANSFER001"}
+    new = _transfer_lint(
+        tmp_path,
+        "host = _TR_PROBE.get(dev * 2)\n"
+        "        return host.tolist()",
+    )
+    assert new == []
+
+
+def test_transfer_non_hot_module_not_boundary_checked(tmp_path):
+    """TRANSFER001 scopes to the hot data-plane leaves — a cold utility
+    module may device_get freely (it is not on a ledger-gated path)."""
+    pkg = make_pkg(
+        tmp_path,
+        {"util/helpers.py": """
+            import jax
+
+            def peek(x):
+                return jax.device_get(x)
+        """},
+    )
+    assert [f for f in lint(pkg) if f.rule == "TRANSFER001"] == []
+
+
+def test_transfer_ledger_label_hygiene(tmp_path):
+    """TRANSFER002 fires on a non-literal label, a duplicate label
+    (package-wide), and a ghost handle that audits nothing."""
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "runtime/replica.py": """
+                from fixpkg.utils import transfers
+
+                _LBL = "replica." + "dyn"
+                _TR_DYN = transfers.register(_LBL)
+                _TR_DUP = transfers.register("shared.site")
+                _TR_GHOST = transfers.register("replica.ghost")
+
+
+                def use(x):
+                    return _TR_DYN.get(_TR_DUP.get(x))
+            """,
+            "runtime/fleet.py": """
+                from fixpkg.utils import transfers
+
+                _TR_ALSO = transfers.register("shared.site")
+
+
+                def use(x):
+                    return _TR_ALSO.get(x)
+            """,
+        },
+    )
+    new = [f for f in lint(pkg) if f.rule == "TRANSFER002"]
+    msgs = "\n".join(f.message for f in new)
+    assert "non-literal label" in msgs
+    assert "'shared.site' already registered" in msgs
+    assert "ghost label" in msgs
+    assert len(new) == 3, msgs
+
+
+def test_mutation_unshimmed_device_get_in_relay_flush_is_caught():
+    """ISSUE 17 acceptance: a raw ``jax.device_get`` snuck into the
+    relay flush path of the REAL replica turns the gate red
+    (TRANSFER001) — the exact invisible-to-the-ledger crossing class
+    the TRANSFER family exists to keep out of the hot modules."""
+    rel = f"{PKG}/runtime/replica.py"
+    anchor = "                sl = self.model.extract_rows(self.state, jnp.asarray(rows))"
+    assert anchor in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(
+            anchor, anchor + "\n                _dbg = jax.device_get(sl)", 1
+        ),
+    )
+    assert any(
+        f.rule == "TRANSFER001" and "device_get" in f.message
+        and "_relay_flush" in f.message
+        for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_audited_wal_fetch_bypass_is_caught():
+    """ISSUE 17 acceptance: routing the WAL-entry fetch around its
+    audited site in the REAL replica is doubly red — the raw
+    ``jax.device_get`` is an unaudited crossing (TRANSFER001) AND the
+    orphaned ``_TR_WAL_ENTRIES`` handle becomes a ghost label
+    (TRANSFER002): the ledger would still declare the site while
+    counting nothing."""
+    rel = f"{PKG}/runtime/replica.py"
+    anchor = "        got = _TR_WAL_ENTRIES.get(a)"
+    assert anchor in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel, lambda s: s.replace(anchor, "        got = jax.device_get(a)", 1)
+    )
+    assert any(f.rule == "TRANSFER001" for f in new), new
+    assert any(
+        f.rule == "TRANSFER002" and "_TR_WAL_ENTRIES" in f.message
+        and "ghost" in f.message
+        for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_meshplane_ledger_bypass_is_caught():
+    """ISSUE 17 acceptance: shipping the narrow plane's dense bundle
+    with a raw ``jax.device_put`` instead of the audited
+    ``_TR_SHIP_DENSE`` site turns the gate red (TRANSFER001 +
+    TRANSFER002 ghost) — the retirement evidence in the mesh bench
+    diffs exactly this site, so an un-audited ship would silently
+    zero the before/after story."""
+    rel = f"{PKG}/runtime/meshplane.py"
+    anchor = "        shipped = _TR_SHIP_DENSE.put(bundle)"
+    assert anchor in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(anchor, "        shipped = jax.device_put(bundle)", 1),
+    )
+    assert any(
+        f.rule == "TRANSFER001" and "device_put" in f.message for f in new
+    ), "\n".join(f.render() for f in new)
+    assert any(
+        f.rule == "TRANSFER002" and "_TR_SHIP_DENSE" in f.message for f in new
+    )

@@ -1,8 +1,8 @@
 """The crdtlint tier-1 gate.
 
 One test runs the FULL rule suite (all families: LOCK, RACE, SYNC,
-PURE, DONATE, WIRE, WAL, OBS, SHAPE, LEAK, SPMD + the SUPPRESS
-hygiene pass) over the real package
+PURE, DONATE, WIRE, WAL, OBS, SHAPE, LEAK, SPMD, TRANSFER + the
+SUPPRESS hygiene pass) over the real package
 through the engine and fails on any non-baselined finding — this is the
 regression gate CI leans on, so it renders findings verbatim on
 failure. The rest pin the gate's own wiring: the checked-in protocol
@@ -51,11 +51,12 @@ def test_gate_covers_every_catalogued_family():
                    "RACE003", "RACE004", "RACE005", "SYNC001", "PURE001",
                    "DONATE001", "WIRE001", "WIRE005", "WAL001", "WAL002",
                    "OBS001", "OBS002", "SHAPE001", "SHAPE002", "LEAK001",
-                   "SPMD001", "SUPPRESS001", "SUPPRESS002"):
+                   "SPMD001", "TRANSFER001", "TRANSFER002",
+                   "SUPPRESS001", "SUPPRESS002"):
         assert family in catalogued
     # every registered checker's module exports at least one catalogued
     # rule id (wiring smoke, not a bijection)
-    assert len(ALL_RULES) >= 12
+    assert len(ALL_RULES) >= 13
 
 
 def test_full_suite_wall_clock_budget():
@@ -97,6 +98,37 @@ def test_jobs_parallel_matches_serial_on_red_tree():
     parallel = run_lint([REPO_ROOT / PKG], overlay=overlay, jobs=3)
     assert serial == parallel
     assert any(f.rule == "SHAPE001" for f in serial[0])
+
+
+def test_jobs_parallel_matches_serial_on_transfer_red_tree():
+    """TRANSFER parity leg (ISSUE 17): the transfer checker is part
+    whole-project ledger scan (TRANSFER002 dedupes labels package-wide)
+    and part per-module boundary walk — a per-file shard would lose the
+    cross-module duplicate-label edge, so the per-rule sharding must
+    keep a firing TRANSFER tree byte-identical serial vs parallel."""
+    rel = f"{PKG}/runtime/replica.py"
+    src = (REPO_ROOT / rel).read_text()
+    anchor = "        got = _TR_WAL_ENTRIES.get(a)"
+    assert anchor in src
+    overlay = {rel: src.replace(anchor, "        got = jax.device_get(a)", 1)}
+    serial = run_lint([REPO_ROOT / PKG], overlay=overlay)
+    parallel = run_lint([REPO_ROOT / PKG], overlay=overlay, jobs=3)
+    assert serial == parallel
+    assert any(f.rule == "TRANSFER001" for f in serial[0])
+
+
+def test_transfer_family_pinned_at_zero_findings_empty_baseline():
+    """The TRANSFER family gates the real tree at ZERO findings with an
+    EMPTY baseline — the device-resident campaign's instrument starts
+    clean, so any future un-audited crossing is a red gate, not a new
+    baseline entry."""
+    baseline = load_baseline(DEFAULT_BASELINE)
+    assert not [e for e in baseline if e[1].startswith("TRANSFER")]
+    new, baselined, _allowed = run_lint(
+        [REPO_ROOT / PKG], select={"TRANSFER001", "TRANSFER002"},
+    )
+    assert [f for f in new if f.rule.startswith("TRANSFER")] == []
+    assert baselined == []
 
 
 def test_stats_reports_per_rule_timing():
@@ -159,7 +191,8 @@ def test_cli_list_rules_names_all_families():
     out = _cli("--list-rules").stdout
     for rule in ("LOCK002", "LOCK003", "RACE001", "RACE005", "WIRE001",
                  "WIRE004", "WIRE005", "WAL001", "WAL002", "SHAPE001",
-                 "SHAPE002", "LEAK001", "SPMD001", "SUPPRESS001"):
+                 "SHAPE002", "LEAK001", "SPMD001", "TRANSFER001",
+                 "TRANSFER002", "SUPPRESS001"):
         assert rule in out
 
 

@@ -386,14 +386,16 @@ def test_serve_gauges_cleanup_on_unregister_fleet(transport):
 
 
 def test_jit_compile_collector_unregistered_on_close(transport):
-    """A closed plane must stop running the compile-cache audit and
-    drop its varz source — the unregister-cleanup contract every other
-    collector already honours."""
+    """A closed plane must stop running the compile-cache and transfer
+    audits and drop their varz sources — the unregister-cleanup contract
+    every other collector already honours."""
     plane = Observability()
     try:
         assert "jitcache" in plane.varz()["sources"]
+        assert "transfers" in plane.varz()["sources"]
         ncoll = len(plane.registry._collectors)
     finally:
         plane.close()
     assert "jitcache" not in plane.varz()["sources"]
-    assert len(plane.registry._collectors) == ncoll - 1
+    assert "transfers" not in plane.varz()["sources"]
+    assert len(plane.registry._collectors) == ncoll - 2

@@ -92,6 +92,13 @@ RULE_CATALOG = [
     ("SPMD001", "shard_map-unsafe construct in a transition-contract module: "
                 "host callback, Python branch on a replica-axis size, or "
                 "axis-free reduction over the replica axis"),
+    ("TRANSFER001", "device↔host crossing in a hot module (device_get/"
+                    "device_put, np.asarray on a device value, .item()/"
+                    ".tolist()/int()/float(), host iteration) that bypasses "
+                    "the audited transfer-ledger shim (utils/transfers)"),
+    ("TRANSFER002", "transfer-ledger site hygiene: non-literal site label, "
+                    "duplicate label (counts would merge), or ghost label "
+                    "(registered but never used)"),
     ("SUPPRESS001", "stale allow[...] comment matching no finding (hygiene; "
                     "not itself suppressible)"),
     ("SUPPRESS002", "stale baseline entry matching no finding (hygiene; "

@@ -33,6 +33,7 @@ FAMILY_TAGS = {
     "shape": "SHAPE",
     "leak": "LEAK",
     "spmd": "SPMD",
+    "transfer": "TRANSFER",
 }
 
 #: hygiene meta-rules (stale suppressions). They report on the

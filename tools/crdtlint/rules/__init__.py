@@ -102,6 +102,7 @@ from tools.crdtlint.rules.obs import check_obs
 from tools.crdtlint.rules.shapes import check_shapes
 from tools.crdtlint.rules.leaks import check_leaks
 from tools.crdtlint.rules.spmd import check_spmd
+from tools.crdtlint.rules.transfers import check_transfers
 
 ALL_RULES = [
     check_lock_discipline,
@@ -116,4 +117,5 @@ ALL_RULES = [
     check_shapes,
     check_leaks,
     check_spmd,
+    check_transfers,
 ]

@@ -1,0 +1,445 @@
+"""TRANSFER001/TRANSFER002 — device↔host transfer-boundary audit.
+
+The device-resident data-plane campaign (ROADMAP) retires host
+round-trips one measured step at a time, and the instrument only works
+if EVERY crossing in the hot modules is audited: a raw
+``jax.device_get`` snuck into a drain path is invisible to the ledger
+(``utils/transfers.py``), so every bench gate diffing ledger snapshots
+under-counts and every later retirement's before/after evidence lies.
+
+- **TRANSFER001** — a device↔host crossing in a hot module
+  (``replica`` / ``fleet`` / ``meshplane`` / ``serve`` / ``treesync`` /
+  ``transition`` / ``tcp_transport``) that does not go through an
+  audited transfer site. Crossing forms, per the jax data-movement
+  model: ``jax.device_get``/``jax.device_put`` calls;
+  ``np.asarray``/``np.array`` on a device-tainted value; ``.item()`` /
+  ``.tolist()`` / ``.__array__()`` on a device-tainted receiver;
+  ``int()``/``float()`` coercion of a device-tainted value (static
+  shape arithmetic exempt, as in SYNC001); host-side iteration
+  directly over a device-tainted array. The audited forms —
+  ``<site>.get(x)`` / ``<site>.put(x)`` where ``<site>`` is a
+  module-level handle from ``transfers.register("label")``, or
+  ``transfers.audited_get/put(x, site)`` — are green: that is the
+  ledger's counted path.
+- **TRANSFER002** — the declared-vs-reached cross-check over the site
+  labels themselves, mirroring OBS001: a ``transfers.register`` call
+  whose label is not a string literal (the ledger keys benches diff
+  must be statically knowable); two registrations sharing one label
+  (the runtime collision guard raises at import — the lint catches it
+  before a process does); a registered handle never used in its module
+  (ghost label: it inflates the declared-site vocabulary while
+  auditing nothing).
+
+Device taint is per-outer-function fix-point in the shapes.py style:
+seeds are jit-dispatch results (``jit_*`` / ``jit.<kernel>`` /
+``fleet_*``), ``self.model.*`` kernel calls, ``jnp.*`` constructions,
+``device_put``/``<site>.put`` results, and the canonical device-state
+attributes (``self.state`` / ``self._dev``); taint propagates through
+assignment, tuple unpacking, and attribute/subscript reads, and dies
+at any host materialisation (``<site>.get``, ``np.asarray``,
+``device_get``, ``int``/``float``, ``.item()``/``.tolist()``).
+Host-side numpy work in the same functions stays unflagged — precision
+is what makes a default-on boundary rule livable.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.crdtlint.engine import Finding, ModuleInfo, Project, _dotted
+from tools.crdtlint.rules import outer_function_defs
+from tools.crdtlint.rules.hostsync import _numpy_aliases, _static_shape_only
+from tools.crdtlint.rules.shapes import _is_jit_dispatch
+
+RULE_BOUNDARY = "TRANSFER001"
+RULE_LEDGER = "TRANSFER002"
+
+#: the device-adjacent hot modules the ledger discipline covers: the
+#: replica/fleet data planes, the mesh collective plane, the serving
+#: front door, the relay tier, the pure-transition layer, and the TCP
+#: transport (which must never touch device arrays at all)
+_HOT_LEAVES = {
+    "replica", "fleet", "meshplane", "serve", "treesync", "transition",
+    "tcp_transport",
+}
+
+#: receiver-method crossings (``.__array__()`` is the protocol form
+#: ``np.asarray`` lowers to; callers rarely write it, but a rename must
+#: not open a bypass)
+_CROSS_METHODS = {"item", "tolist", "__array__"}
+
+_SITE_METHODS = {"get", "put", "note"}
+
+
+def _leaf(chain: str) -> str:
+    return chain.rsplit(".", 1)[-1]
+
+
+def _is_transfers_module_name(mod: ModuleInfo, name: str) -> bool:
+    """Does ``name`` resolve (via the real import table) to the
+    project's ``utils/transfers`` ledger module? Literal ``transfers``
+    accepted as fallback, the obs-rule idiom."""
+    imp = mod.imports.get(name)
+    if imp is not None and imp[0] in ("mod", "modroot"):
+        return imp[1].rsplit(".", 1)[-1] == "transfers"
+    return name == "transfers"
+
+
+def _is_register_call(node: ast.Call, mod: ModuleInfo) -> bool:
+    """``transfers.register(...)`` / imported ``register(...)``."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "register":
+        if isinstance(f.value, ast.Name):
+            return _is_transfers_module_name(mod, f.value.id)
+        return False
+    if isinstance(f, ast.Name) and f.id == "register":
+        imp = mod.imports.get("register")
+        return (
+            imp is not None
+            and imp[0] == "sym"
+            and imp[1].rsplit(".", 1)[-1] == "transfers"
+        )
+    return False
+
+
+def _is_audited_helper(node: ast.Call, mod: ModuleInfo, leaf: str) -> bool:
+    """``transfers.audited_get/put(x, site)`` function forms."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == leaf:
+        if isinstance(f.value, ast.Name):
+            return _is_transfers_module_name(mod, f.value.id)
+        return False
+    if isinstance(f, ast.Name) and f.id == leaf:
+        imp = mod.imports.get(leaf)
+        return (
+            imp is not None
+            and imp[0] == "sym"
+            and imp[1].rsplit(".", 1)[-1] == "transfers"
+        )
+    return False
+
+
+def _site_handles(mod: ModuleInfo) -> dict[str, tuple[int, ast.Call]]:
+    """Module-level ``NAME = transfers.register("label")`` handles:
+    name -> (line, register call). Handles are module constants by
+    convention — that is what makes ``NAME.get`` statically auditable."""
+    out: dict[str, tuple[int, ast.Call]] = {}
+    for node in mod.tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Call)
+            and _is_register_call(node.value, mod)
+        ):
+            out[node.targets[0].id] = (node.lineno, node.value)
+    return out
+
+
+def _is_site_method_call(node: ast.Call, handles: dict) -> bool:
+    """``<handle>.get/.put/.note(...)`` on a module-level site handle."""
+    f = node.func
+    return (
+        isinstance(f, ast.Attribute)
+        and f.attr in _SITE_METHODS
+        and isinstance(f.value, ast.Name)
+        and f.value.id in handles
+    )
+
+
+class _Taint:
+    """Per-outer-function device-value taint, fix-point over the
+    function body (nested defs included — closures share the enclosing
+    scope's locals, the shapes.py/OBS002 convention)."""
+
+    def __init__(self, mod: ModuleInfo, fn: ast.FunctionDef, handles: dict):
+        self.mod = mod
+        self.handles = handles
+        self.names: set[str] = set()
+        self._fix_point(fn)
+
+    # -- expression classification ------------------------------------
+
+    def _device_call(self, node: ast.Call) -> bool:
+        """Calls whose RESULT lives on device: jit dispatches, model
+        kernel seams, jnp constructions, placements."""
+        if _is_jit_dispatch(node):
+            return True
+        chain = _dotted(node.func) or ""
+        parts = chain.split(".")
+        # self.model.winners_for_keys / model.row_apply — the store
+        # kernel seam returns device pytrees
+        if len(parts) >= 2 and parts[-2] == "model":
+            return True
+        if parts[0] in ("jnp", "lax") or chain.startswith("jax.numpy"):
+            return True
+        if _leaf(chain) == "device_put":
+            return True
+        # audited placement: <site>.put(x) returns a device value
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "put"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in self.handles
+        ):
+            return True
+        if _is_audited_helper(node, self.mod, "audited_put"):
+            return True
+        return False
+
+    def _host_call(self, node: ast.Call) -> bool:
+        """Calls whose result is HOST regardless of operand taint."""
+        chain = _dotted(node.func) or ""
+        leaf = _leaf(chain)
+        if leaf in ("device_get", "int", "float", "len", "bool"):
+            return True
+        if leaf in ("asarray", "array"):
+            return True
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("get", "item", "tolist")
+        ):
+            return True
+        if _is_audited_helper(node, self.mod, "audited_get"):
+            return True
+        return False
+
+    def is_tainted(self, node: ast.AST) -> bool:
+        """Is this expression device-valued under the current taint?"""
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            chain = _dotted(node) or ""
+            # canonical device-state attributes
+            if chain.startswith("self.state") or chain.startswith("self._dev"):
+                return True
+            # static-shape reads of a device array are host ints
+            if node.attr in ("shape", "ndim", "size", "dtype", "nbytes"):
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Call):
+            # getattr(state, c) reads a column off a (possibly tainted)
+            # store pytree — the dynamic-column idiom on snapshot paths
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id == "getattr"
+                and node.args
+            ):
+                return self.is_tainted(node.args[0])
+            if self._host_call(node):
+                return False
+            return self._device_call(node)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(v is not None and self.is_tainted(v) for v in node.values)
+        if isinstance(node, ast.IfExp):
+            return self.is_tainted(node.body) or self.is_tainted(node.orelse)
+        if isinstance(node, ast.BinOp):
+            return self.is_tainted(node.left) or self.is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, (ast.GeneratorExp, ast.ListComp, ast.SetComp)):
+            return self.is_tainted(node.elt)
+        return False
+
+    # -- fix point ------------------------------------------------------
+
+    def _fix_point(self, fn: ast.FunctionDef) -> None:
+        while True:
+            before = len(self.names)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    if self.is_tainted(node.value):
+                        for t in node.targets:
+                            self._taint_target(t)
+                elif isinstance(node, ast.AnnAssign):
+                    if node.value is not None and self.is_tainted(node.value):
+                        self._taint_target(node.target)
+                elif isinstance(node, ast.AugAssign):
+                    if self.is_tainted(node.value):
+                        self._taint_target(node.target)
+            if len(self.names) == before:
+                return
+
+    def _taint_target(self, t: ast.AST) -> None:
+        if isinstance(t, ast.Name):
+            self.names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            # tuple unpack of a device pytree: every bound name is a
+            # device leaf (jax.device_get((a, b)) would have killed the
+            # taint before we got here)
+            for e in t.elts:
+                self._taint_target(e)
+        elif isinstance(t, ast.Starred):
+            self._taint_target(t.value)
+
+
+def _boundary_findings(
+    mod: ModuleInfo,
+    qual: tuple[str, ...],
+    fn: ast.FunctionDef,
+    handles: dict,
+    np_aliases: set[str],
+) -> list[Finding]:
+    taint = _Taint(mod, fn, handles)
+    name = ".".join(qual)
+    findings: list[Finding] = []
+    seen: set[int] = set()
+
+    def report(line: int, msg: str) -> None:
+        if line not in seen:
+            seen.add(line)
+            findings.append(Finding(mod.rel, line, RULE_BOUNDARY, msg))
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            chain = _dotted(node.func) or ""
+            leaf = _leaf(chain)
+            # raw placement/readback: ALWAYS a crossing, audited or not
+            # — the audited form is <site>.get/.put, never device_get
+            if leaf in ("device_get", "device_put") and not _is_site_method_call(
+                node, handles
+            ):
+                report(
+                    node.lineno,
+                    f"raw jax.{leaf} in hot module function {mod.name}.{name} "
+                    f"— route the crossing through an audited transfer site "
+                    f"(utils/transfers.register) so the ledger counts it",
+                )
+                continue
+            # np.asarray / np.array on a device value: implicit readback
+            head = chain.split(".", 1)[0] if chain else ""
+            if (
+                chain
+                and (head in np_aliases or chain in np_aliases)
+                and leaf in ("asarray", "array")
+                and node.args
+                and taint.is_tainted(node.args[0])
+            ):
+                report(
+                    node.lineno,
+                    f"{chain}(...) materialises a device array on host in "
+                    f"{mod.name}.{name} — an unaudited crossing; use "
+                    f"<site>.get(...) so the ledger counts it",
+                )
+                continue
+            # .item()/.tolist()/__array__() on a device receiver
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _CROSS_METHODS
+                and taint.is_tainted(node.func.value)
+            ):
+                report(
+                    node.lineno,
+                    f".{node.func.attr}() on a device value in "
+                    f"{mod.name}.{name} — an unaudited crossing; fetch via "
+                    f"an audited site first",
+                )
+                continue
+            # int()/float() coercion of a device scalar
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in ("int", "float")
+                and len(node.args) == 1
+                and not _static_shape_only(node.args[0])
+                and taint.is_tainted(node.args[0])
+            ):
+                report(
+                    node.lineno,
+                    f"{node.func.id}() coerces a device value to host in "
+                    f"{mod.name}.{name} — an unaudited crossing; fetch via "
+                    f"an audited site first",
+                )
+        elif isinstance(node, ast.For):
+            if taint.is_tainted(node.iter) and not isinstance(
+                node.iter, ast.Call
+            ):
+                report(
+                    node.iter.lineno,
+                    f"host-side iteration over a device array in "
+                    f"{mod.name}.{name} — one readback per element; fetch "
+                    f"once via an audited site and iterate the host copy",
+                )
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                if taint.is_tainted(gen.iter) and not isinstance(
+                    gen.iter, ast.Call
+                ):
+                    report(
+                        gen.iter.lineno,
+                        f"host-side iteration over a device array in "
+                        f"{mod.name}.{name} — one readback per element; "
+                        f"fetch once via an audited site and iterate the "
+                        f"host copy",
+                    )
+    return findings
+
+
+def _ledger_findings(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    #: label -> (module rel, line) of first registration, package-wide
+    labels: dict[str, tuple[str, int]] = {}
+    for mod_name in sorted(project.modules):
+        mod = project.modules[mod_name]
+        handles = _site_handles(mod)
+        if not handles:
+            continue
+        used: set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if node.id in handles:
+                    used.add(node.id)
+        for hname in sorted(handles):
+            line, call = handles[hname]
+            label_node = call.args[0] if call.args else None
+            if not (
+                isinstance(label_node, ast.Constant)
+                and isinstance(label_node.value, str)
+            ):
+                findings.append(Finding(
+                    mod.rel, line, RULE_LEDGER,
+                    f"transfer site {hname} registers a non-literal label — "
+                    f"ledger keys must be statically knowable (bench gates "
+                    f"and dashboards key on them)",
+                ))
+                continue
+            label = label_node.value
+            prior = labels.get(label)
+            if prior is not None:
+                findings.append(Finding(
+                    mod.rel, line, RULE_LEDGER,
+                    f"transfer site label {label!r} already registered at "
+                    f"{prior[0]}:{prior[1]} — duplicate labels merge ledger "
+                    f"counts (the runtime guard raises at import; rename "
+                    f"one site)",
+                ))
+            else:
+                labels[label] = (mod.rel, line)
+            if hname not in used:
+                findings.append(Finding(
+                    mod.rel, line, RULE_LEDGER,
+                    f"transfer site {hname} (label {label!r}) is registered "
+                    f"but never used in {mod.name} — ghost label: it "
+                    f"declares an audited crossing that audits nothing "
+                    f"(delete it or route the crossing through it)",
+                ))
+    return findings
+
+
+def check_transfers(project: Project) -> list[Finding]:
+    findings = _ledger_findings(project)
+    for mod_name in sorted(project.modules):
+        mod = project.modules[mod_name]
+        if mod_name.rsplit(".", 1)[-1] not in _HOT_LEAVES:
+            continue
+        handles = _site_handles(mod)
+        np_aliases = _numpy_aliases(mod)
+        for qual, fn in outer_function_defs(mod.tree):
+            findings.extend(
+                _boundary_findings(mod, qual, fn, handles, np_aliases)
+            )
+    return findings
