@@ -102,6 +102,7 @@ from delta_crdt_ex_tpu.runtime.replica import (
     _StackedLevels,
 )
 from delta_crdt_ex_tpu.utils import transfers
+from delta_crdt_ex_tpu.utils.faults import faultpoint
 
 # -- audited device↔host transfer sites (crdtlint TRANSFER001) --------
 _TR_MESH_PLACE = transfers.register("fleet.mesh_place")
@@ -1055,6 +1056,7 @@ class Fleet:
 
         def loop():
             while not self._stop.is_set():
+                faultpoint("fleet.loop")
                 self.tick()
                 self.run_duties()
                 self._wake.wait(timeout=min(min_interval, 0.05))

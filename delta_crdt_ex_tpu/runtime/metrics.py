@@ -700,6 +700,15 @@ class MetricsBridge:
             "Bytes moved across the device-host boundary per audited site",
             ("site",),
         )
+        # fault-injection trips (crdtlint v6 runtime half): a real
+        # counter — the faults registry emits one FAULT_TRIP per trip,
+        # not absolute totals, so chaos runs attach mid-process and see
+        # only their own schedule's trips
+        self.fault_trips = c(
+            "crdt_fault_trips_total",
+            "Injected-fault trips per labelled fault-point site",
+            ("site",),
+        )
         # batchable handlers for the two per-message hot families: the
         # grouped ingest path emits them via telemetry.execute_many, and
         # the batch form folds the whole group under ONE registry-lock
@@ -733,6 +742,7 @@ class MetricsBridge:
             (telemetry.MESH_EXCHANGE, self._on_mesh_exchange),
             (telemetry.JIT_COMPILE, self._on_jit_compile),
             (telemetry.TRANSFER, self._on_transfer),
+            (telemetry.FAULT_TRIP, self._on_fault_trip),
             (telemetry.SERVE_ADMIT, self._on_serve_admit),
             (telemetry.SERVE_SHED, self._on_serve_shed),
             (telemetry.SERVE_READ, self._on_serve_read),
@@ -908,6 +918,11 @@ class MetricsBridge:
             self.transfers._set_held(lb, meas.get("crossings", 0))
             self.transfer_bytes._set_held(lb, meas.get("bytes", 0))
 
+    def _on_fault_trip(self, _event, meas, meta) -> None:
+        lb = (self._s(meta.get("site")),)
+        with self._lock:
+            self.fault_trips._inc_held(lb, meas.get("trips", 1))
+
     def _on_serve_admit(self, _event, meas, meta) -> None:
         lb = (self._s(meta.get("name")),)
         g = meas.get
@@ -1031,18 +1046,43 @@ class FlightRecorder:
         with self._lock:
             return self._next
 
-    def dump(self, log=None) -> int:
+    def dump(self, log=None, path: str | None = None) -> int:
         """Write the ring through the logger (the crash black box);
-        returns the number of events dumped."""
+        returns the number of events dumped.
+
+        Exception-safe per event: a logging handler (or an unprintable
+        field value) raising mid-dump must not lose the REMAINING ring
+        events — the black box's whole value is the events nearest the
+        crash, which are the last ones dumped. With ``path`` the ring
+        is also appended to that file as JSON lines (best-effort,
+        ``repr`` fallback for non-JSON fields), so chaos runs keep the
+        black box after the process dies and the log stream with it."""
         log = log or logger
         events = self.events()
-        log.error(
-            "flight recorder %r: %d event(s), %d older dropped",
-            self.name, len(events), self.dropped(),
-        )
+        try:
+            log.error(
+                "flight recorder %r: %d event(s), %d older dropped",
+                self.name, len(events), self.dropped(),
+            )
+        except Exception:
+            pass  # a dying log sink must not stop the event dump below
         for e in events:
-            fields = {k: v for k, v in e.items() if k not in ("t", "id", "kind")}
-            log.error("flight %r #%d %.6f %s %s", self.name, e["id"], e["t"], e["kind"], fields)
+            try:
+                fields = {k: v for k, v in e.items() if k not in ("t", "id", "kind")}
+                log.error("flight %r #%d %.6f %s %s", self.name, e["id"], e["t"], e["kind"], fields)
+            except Exception:
+                continue  # skip the poison event, keep the rest
+        if path is not None:
+            try:
+                import json as _json
+
+                with open(path, "a", encoding="utf-8") as f:
+                    for e in events:
+                        f.write(_json.dumps(
+                            {"replica": self.name, **e}, default=repr,
+                        ) + "\n")
+            except OSError:
+                logger.debug("flight dump to %r failed", path, exc_info=True)
         return len(events)
 
 

@@ -72,6 +72,7 @@ from delta_crdt_ex_tpu.runtime.transport import (
 )
 from delta_crdt_ex_tpu.runtime.wal import ReplayClock, WalLog
 from delta_crdt_ex_tpu.utils import transfers
+from delta_crdt_ex_tpu.utils.faults import faultpoint
 
 logger = logging.getLogger("delta_crdt_ex_tpu")
 
@@ -258,6 +259,7 @@ class Replica:
         tree_degrade_ratio: float = 0.25,
         tree_group=None,
         obs=None,
+        flight_dump_path: str | None = None,
         device=None,
     ):
         # max_sync_size validation (reference raises, causal_crdt.ex:52-62)
@@ -337,6 +339,10 @@ class Replica:
         self.flight = (
             self._obs.recorder(self.name) if self._obs is not None else None
         )
+        #: where :meth:`crash` additionally dumps the flight ring as
+        #: JSONL (``None`` = logger only) — chaos runs keep the black
+        #: box after the process dies
+        self.flight_dump_path = flight_dump_path
         self._lag = self._obs.lag if self._obs is not None else None
         self._loop_ts = time.monotonic()
         #: active only inside a ``process_pending`` drain pass: SYNC_DONE
@@ -817,15 +823,24 @@ class Replica:
         record. Replay must not re-log what it is replaying."""
         if self._replaying:
             return
+        faultpoint("replica.durable")
         if self._wal is None:
             return self._persist()
         t0 = time.perf_counter()
-        # crdtlint: allow[LOCK003] group commit IS the durability point:
-        # the record must be staged+fsynced (per fsync_mode) before the
-        # apply is acknowledged, and WalLog is replica-lock-serialised by
-        # contract ("not thread-safe by itself")
-        n_bytes = self._wal.append(record_fn())
-        self._wal.commit()  # crdtlint: allow[LOCK003] group commit (see above)
+        try:
+            # crdtlint: allow[LOCK003] group commit IS the durability point:
+            # the record must be staged+fsynced (per fsync_mode) before the
+            # apply is acknowledged, and WalLog is replica-lock-serialised by
+            # contract ("not thread-safe by itself")
+            n_bytes = self._wal.append(record_fn())
+            self._wal.commit()  # crdtlint: allow[LOCK003] group commit (see above)
+        except BaseException:
+            # failed commit: drop the staged record — the caller rolls
+            # the seq back, and a stale staged record would otherwise
+            # flush alongside the retry's re-minted seq (duplicate-seq
+            # logs are corruption to recovery, by design)
+            self._wal.abort()
+            raise
         self._wal_unc += 1
         if telemetry.has_handlers(telemetry.WAL_APPEND):
             telemetry.execute(
@@ -840,9 +855,21 @@ class Replica:
         if self._wal_unc >= self.compact_every:
             self._compact_wal()
 
+    def _commit_abort(self, exc: BaseException) -> None:
+        """Shared tail of every failed durability point: roll the seq
+        back (it must keep naming the last durable record — recovery
+        replays a contiguous prefix) and leave a black-box trace, so a
+        post-mortem of a crash-after-abort shows WHICH commit died and
+        why (the FAULT002 discipline: failure paths re-raise AND
+        record)."""
+        self._seq -= 1
+        self._flight("commit_abort", seq=self._seq, error=repr(exc))
+
     def _durable_batch(self, batch: list, ts) -> None:
         """Durability point for one local mutation batch — the single
         definition of the ``batch`` record schema (both flush paths)."""
+        if not self._replaying:
+            faultpoint("replica.commit.batch")
         if self._lag is not None and not self._replaying:
             # sample THIS local commit for replication-lag tracing (the
             # tracer keeps every sample_every-th seq; replay re-applies
@@ -1343,6 +1370,15 @@ class Replica:
                 self._read_cache_kh = None
                 maintained = False
 
+        # durability happens-before publication (crdtlint FAULT003): a
+        # crash between the two may lose only *unpublished* work — never
+        # publish state a recovery cannot replay. A failed append rolls
+        # the seq back so it still names the last durable record.
+        try:
+            self._durable_batch(batch, ts)
+        except BaseException as e:
+            self._commit_abort(e)
+            raise
         if need_winners:
             w_after = self._batch_winner_records(touched, any_clear)
             touched_all = dict(touched)
@@ -1351,7 +1387,6 @@ class Replica:
             self._emit_diffs(touched_all, w_before, w_after, maintained)
         else:
             self._note_state_changed(lambda: n_changed, maintained)
-        self._durable_batch(batch, ts)
         # every op can kill/replace a previously-live entry, stranding its
         # payload in the host dict until the next prune
         self._gc_pressure += n
@@ -1412,8 +1447,14 @@ class Replica:
                 self._read_cache = None
                 self._read_cache_kh = None
 
+        # durability happens-before publication (FAULT003, see
+        # _flush_batch); roll the seq back if the append fails
+        try:
+            self._durable_batch(batch, ts)
+        except BaseException as e:
+            self._commit_abort(e)
+            raise
         self._note_state_changed(lambda: n_changed, maintained)
-        self._durable_batch(batch, ts)
         self._gc_pressure += n
         self._maybe_gc()
 
@@ -2197,6 +2238,7 @@ class Replica:
         flush; the remainder stays pending. Returns messages emitted."""
         if not self.tree_gossip:
             return 0
+        faultpoint("replica.relay.flush")
         with self._lock:
             if not self._relay_pending and not self._relay_defer:
                 return 0
@@ -2665,6 +2707,22 @@ class Replica:
             return
 
         self._seq += 1
+        # durability happens-before publication (crdtlint FAULT003): log
+        # the merged slice before diffs/serve-pub see it, rolling the
+        # seq back if the append fails so it still names the last
+        # durable record
+        try:
+            self._durable(
+                lambda: {
+                    "kind": "entries",
+                    "seq": self._seq,
+                    "arrays": self._wal_arrays_host(a),
+                    "payloads": dict(msg.payloads),
+                }
+            )
+        except BaseException as e:
+            self._commit_abort(e)
+            raise
         # relay bookkeeping (ISSUE 15): the merged rows park for the
         # next flush's changed-only stamping toward every tree link
         # except the source edge — default-arg capture of JUST the two
@@ -2714,14 +2772,6 @@ class Replica:
                     "plane": "host" if isinstance(a["key"], np.ndarray) else "device",
                 },
             )
-        self._durable(
-            lambda: {
-                "kind": "entries",
-                "seq": self._seq,
-                "arrays": self._wal_arrays_host(a),
-                "payloads": dict(msg.payloads),
-            }
-        )
         # received payloads stick in the host dict even when the merge
         # superseded them, and every KILLED entry strands its payload —
         # a mass-remove wave carries near-zero payloads, so kills must
@@ -3323,6 +3373,27 @@ class Replica:
         readbacks when one is active). Caller holds the lock, has
         stored the merged state, and has invalidated the tree/read
         caches."""
+        # durability happens-before publication (crdtlint FAULT003): the
+        # whole group's WAL records land before the serving plane or
+        # telemetry can observe the merge. A failed append rolls the seq
+        # back to the last record that DID land, so a recovering replica
+        # replays a contiguous prefix of the group.
+        for m in msgs:
+            self._seq += 1
+            a, payloads = m.arrays, m.payloads
+            try:
+                faultpoint("replica.commit.entries")
+                self._durable(
+                    lambda a=a, payloads=payloads: {
+                        "kind": "entries",
+                        "seq": self._seq,
+                        "arrays": self._wal_arrays_host(a),
+                        "payloads": dict(payloads),
+                    }
+                )
+            except BaseException as e:
+                self._commit_abort(e)
+                raise
         # commit boundary for the grouped paths (solo grouped + fleet
         # batched): state stored, payloads registered — publish for the
         # serving plane's lock-free readers
@@ -3385,17 +3456,6 @@ class Replica:
                     for m in msgs
                 ],
                 {"name": self.name, "plane": "host"},
-            )
-        for m in msgs:
-            self._seq += 1
-            a, payloads = m.arrays, m.payloads
-            self._durable(
-                lambda a=a, payloads=payloads: {
-                    "kind": "entries",
-                    "seq": self._seq,
-                    "arrays": self._wal_arrays_host(a),
-                    "payloads": dict(payloads),
-                }
             )
 
     # -- batched replica fleets (ISSUE 6 tentpole) -----------------------
@@ -3919,6 +3979,7 @@ class Replica:
             next_sync = time.monotonic()  # immediate first sync
             next_ckpt = time.monotonic() + self.checkpoint_interval
             while not self._stop.is_set():
+                faultpoint("replica.loop")
                 self.process_pending()
                 with self._lock:
                     # health heartbeat: a wedged loop (stuck merge, dead
@@ -3974,8 +4035,10 @@ class Replica:
             self._thread = None
         if self.flight is not None:
             # the black box: a crashing replica's recent structured
-            # events go out through the logger for the post-mortem
-            self.flight.dump()
+            # events go out through the logger for the post-mortem —
+            # and, with ``flight_dump_path``, to a JSONL file that
+            # outlives the process (the chaos runs' black-box knob)
+            self.flight.dump(path=self.flight_dump_path)
         if self._obs is not None:
             self._obs.unregister_replica(self)
         with self._lock:

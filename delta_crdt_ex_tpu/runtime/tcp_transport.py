@@ -39,6 +39,7 @@ from typing import Any, Hashable
 
 from delta_crdt_ex_tpu.runtime import sync as sync_proto
 from delta_crdt_ex_tpu.runtime.transport import Down, forward_fleet_entries
+from delta_crdt_ex_tpu.utils.faults import FaultInjected, faultpoint
 
 logger = logging.getLogger("delta_crdt_ex_tpu")
 
@@ -320,6 +321,10 @@ class _SenderConn:
             self.sock.close()
         except OSError:
             pass
+        # close() can run ON the sender thread (the _on_dead path fires
+        # from _loop's error handler) — joining ourselves would deadlock
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout=5)
 
     def _loop(self) -> None:
         while True:
@@ -328,6 +333,12 @@ class _SenderConn:
                 return
             with self._dead_lock:
                 self._q_bytes -= len(item[1])
+            try:
+                faultpoint("transport.send")
+            except FaultInjected:
+                # injected send-side loss: this frame is gone, exactly
+                # like a dropped packet — periodic anti-entropy heals it
+                continue
             try:
                 _send_frame(self.sock, item[0], item[1])
                 if self._on_sent is not None:
@@ -766,6 +777,10 @@ class TcpTransport:
                     except OSError:
                         return
                 elif kind == _MSG:
+                    try:
+                        faultpoint("transport.recv")
+                    except FaultInjected:
+                        continue  # injected receive-side loss (see send)
                     name, msg = pickle.loads(payload)
                     self.send(name, msg)
                 elif kind == _MSGZ:
@@ -842,6 +857,8 @@ class TcpTransport:
         # heartbeat conns are owned by the hb thread; joining it (it exits
         # promptly on _stop) lets it close them without a cross-thread race
         self._hb_thread.join(timeout=5)
+        # the accept loop unblocks when the listener above is closed
+        self._accept_thread.join(timeout=5)
         with self._lock:
             conns = list(self._conns.values())
             self._conns.clear()

@@ -32,6 +32,7 @@ SERVE_READ = ("delta_crdt", "serve", "read")  # measurements: reads, retries, du
 TREE_RELAY = ("delta_crdt", "tree", "relay")  # measurements: depth, entries, buckets, links, tx_bytes, rx_bytes, duration_s; metadata: name, tier
 TREE_TOPOLOGY = ("delta_crdt", "tree", "topology")  # measurements: depth, fanout, tier, role (0 leaf/1 relay/2 root), members, down, degraded; metadata: name
 TRANSFER = ("delta_crdt", "transfer", "crossing")  # measurements: crossings, bytes (absolute per-site ledger totals); metadata: site
+FAULT_TRIP = ("delta_crdt", "fault", "trip")  # measurements: trips (per trip); metadata: site
 
 def declared_events() -> tuple[tuple, ...]:
     """Every event tuple this module declares (the OBS001 contract:

@@ -72,6 +72,7 @@ from delta_crdt_ex_tpu.runtime.storage import (
     fsync_dir,
     require_layout,
 )
+from delta_crdt_ex_tpu.utils.faults import CrashInjected, faultpoint
 
 logger = logging.getLogger("delta_crdt_ex_tpu")
 
@@ -251,6 +252,7 @@ class WalLog:
         """Stage one data record; returns its encoded size in bytes.
         Durability follows ``fsync_mode`` — ``"record"`` reaches disk
         here, everything else at :meth:`commit`."""
+        faultpoint("wal.append")
         seq = int(record["seq"])
         blob = _encode(record)
         if self._fd is None:
@@ -260,6 +262,16 @@ class WalLog:
         if self.fsync_mode == "record":
             self._write_out(fsync=True)
         return len(blob)
+
+    def abort(self) -> None:
+        """Drop staged-but-unwritten bytes after a failed append/commit.
+        The caller rolls its seq back and re-stages on retry — an
+        aborted record left in the buffer would ride the NEXT group
+        commit alongside the re-minted seq, and recovery (correctly)
+        rejects a duplicate-seq log as corrupt. Written-but-unfsynced
+        bytes are :meth:`_scrub`-ed inside :meth:`_write_out` itself;
+        this handles the stage-only window before them."""
+        self._buf.clear()
 
     def commit(self) -> None:
         """Group-commit boundary: flush staged records to the OS, fsync
@@ -288,17 +300,72 @@ class WalLog:
             self._last_sync = time.monotonic()
 
     def _write_out(self, fsync: bool) -> None:
+        staged = b""
+        base = None  # file length before THIS batch's bytes landed
         if self._buf:
             if self._fd is None:
                 raise WalCorruption("append buffer with no open segment")
-            os.write(self._fd, bytes(self._buf))
-            self._size += len(self._buf)
+            frac = faultpoint("wal.write")
+            if frac is not None:
+                # cooperating partial-write injection: persist only a
+                # prefix of the staged bytes (fsynced, so the torn tail
+                # is deterministically on disk), then die — the
+                # recovery legs' reproducible truncate-the-tail input
+                buf = bytes(self._buf)
+                n = max(1, min(len(buf) - 1, int(len(buf) * frac)))
+                os.write(self._fd, buf[:n])
+                os.fsync(self._fd)
+                self._buf.clear()
+                raise CrashInjected(
+                    f"partial WAL write injected: {n}/{len(buf)} bytes"
+                )
+            staged = bytes(self._buf)
+            base = os.fstat(self._fd).st_size
+            try:
+                os.write(self._fd, staged)
+            except BaseException:
+                # the batch may be partially written; the caller rolls
+                # its seq back and will RE-stage these records, so both
+                # the file tail and the buffer must forget them
+                self._buf.clear()
+                self._scrub(base)
+                raise
+            self._size += len(staged)
             self._buf.clear()
             self._dirty = True
         if fsync and self._dirty and self._fd is not None:
-            os.fsync(self._fd)
+            try:
+                faultpoint("wal.fsync")
+                os.fsync(self._fd)
+            except BaseException:
+                if base is not None:
+                    # failure atomicity for the group commit: this
+                    # batch's bytes are written but not fsynced, while
+                    # the caller's seq rollback + retry will re-append
+                    # the same seqs — scrub the batch or recovery later
+                    # reads a duplicate-seq log and (correctly) calls
+                    # it corrupt ("sequence regressed")
+                    self._scrub(base)
+                    self._size -= len(staged)
+                raise
             self._dirty = False
             self._last_sync = time.monotonic()
+
+    def _scrub(self, base: int) -> None:
+        """Roll the active segment back to ``base`` bytes after a failed
+        group commit. The segment fd is NOT ``O_APPEND`` (writes track
+        the seek cursor), so the cursor must follow the truncate or the
+        next batch would land past EOF and leave a hole. The truncate is
+        itself fsynced: a scrub that only lives in page cache could
+        resurrect the aborted batch on power loss."""
+        try:
+            os.ftruncate(self._fd, base)
+            os.lseek(self._fd, base, os.SEEK_SET)
+            os.fsync(self._fd)
+        except OSError:
+            # the device itself is failing; recovery's tail scan (and
+            # its seq-regression check) is the remaining line of defence
+            pass
 
     def rotate(self) -> None:
         """Close the active segment; the next append opens a fresh one.
@@ -306,6 +373,7 @@ class WalLog:
         compaction. Interval mode fsyncs the tail here regardless of
         cadence: ``maybe_sync`` can never reach a closed fd, so an
         unflushed tail would otherwise stay cache-only forever."""
+        faultpoint("wal.rotate")
         self._write_out(fsync=self.fsync_mode != "none")
         if self._fd is not None:
             os.close(self._fd)

@@ -3567,3 +3567,178 @@ def test_mutation_meshplane_ledger_bypass_is_caught():
     assert any(
         f.rule == "TRANSFER002" and "_TR_SHIP_DENSE" in f.message for f in new
     )
+
+
+def test_mutation_unrestoring_commit_handler_is_caught():
+    """ISSUE 20 acceptance: gutting the seq-rollback handler around the
+    grouped-entries durability point in the REAL replica turns the gate
+    red (FAULT001) — the loop keeps minting ``self._seq += 1`` while an
+    injected raise at the fault point would leave the group
+    half-advanced with nothing rolling it back."""
+    rel = f"{PKG}/runtime/replica.py"
+    old = (
+        "            except BaseException as e:\n"
+        "                self._commit_abort(e)\n"
+        "                raise"
+    )
+    assert old in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(
+            old, "            except BaseException:\n                raise", 1
+        ),
+    )
+    assert any(
+        f.rule == "FAULT001" and "_commit_entries_group" in f.message
+        for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_publish_before_durable_is_caught():
+    """ISSUE 20 acceptance: reordering publication ahead of the WAL
+    append in the REAL batched-adds commit path turns the gate red
+    (FAULT003) — a crash in the window loses work that lock-free
+    readers already observed."""
+    rel = f"{PKG}/runtime/replica.py"
+    old = (
+        "        try:\n"
+        "            self._durable_batch(batch, ts)\n"
+        "        except BaseException as e:\n"
+        "            self._commit_abort(e)\n"
+        "            raise\n"
+        "        self._note_state_changed(lambda: n_changed, maintained)"
+    )
+    assert old in (REPO_ROOT / rel).read_text()
+    swapped = (
+        "        self._note_state_changed(lambda: n_changed, maintained)\n"
+        "        try:\n"
+        "            self._durable_batch(batch, ts)\n"
+        "        except BaseException as e:\n"
+        "            self._commit_abort(e)\n"
+        "            raise"
+    )
+    new = _overlay_lint(rel, lambda s: s.replace(old, swapped, 1))
+    assert any(
+        f.rule == "FAULT003" and "_flush_batch_adds" in f.message
+        for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_ghost_fault_site_is_caught():
+    """ISSUE 20 acceptance: a SITES vocabulary entry with no faultpoint
+    call site is red (FAULT005) — a chaos schedule naming it could
+    never trip, so the label set must stay exactly the set of program
+    points."""
+    rel = f"{PKG}/utils/faults.py"
+    old = '    "fleet.loop",'
+    assert old in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel,
+        lambda s: s.replace(old, old + '\n    "ghost.site",', 1),
+    )
+    assert any(
+        f.rule == "FAULT005" and "'ghost.site'" in f.message
+        and "ghost" in f.message
+        for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+def test_mutation_nonliteral_faultpoint_label_is_caught():
+    """Companion FAULT005 leg: a faultpoint whose label is a variable
+    (not a string literal) is red — chaos schedules key on statically
+    knowable site names."""
+    rel = f"{PKG}/runtime/wal.py"
+    old = 'faultpoint("wal.rotate")'
+    assert old in (REPO_ROOT / rel).read_text()
+    new = _overlay_lint(
+        rel, lambda s: s.replace(old, "faultpoint(_SITE_ROTATE)", 1)
+    )
+    assert any(
+        f.rule == "FAULT005" and "not a string literal" in f.message
+        for f in new
+    ), "\n".join(f.render() for f in new)
+
+
+# ----------------------------------------------------------------------
+# SUPPRESS003 — allow-comment expiry (ISSUE 20)
+
+
+def test_expired_allow_still_suppresses_through_suppress003(tmp_path):
+    """An expired ``allow[tag expires=...]`` keeps suppressing the
+    underlying finding — the gate goes red through ONE actionable
+    SUPPRESS003 at the comment, not through the original finding
+    popping back up at an unrelated line."""
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/k.py": """
+            import jax
+
+            def probe(f, x):
+                # crdtlint: allow[host-sync expires=2000-01-01] dated
+                jax.jit(f)(x).block_until_ready()
+                return f
+            """,
+        },
+    )
+    new, _baselined, allowed = run_lint([pkg])
+    assert rules_of(new) == {"SUPPRESS003"}
+    assert "expires=2000-01-01" in new[0].message
+    # the original finding routed through the (expired) allow
+    assert any(f.rule.startswith("SYNC") for f in allowed)
+
+
+def test_future_dated_allow_is_quiet(tmp_path):
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/k.py": """
+            import jax
+
+            def probe(f, x):
+                # crdtlint: allow[host-sync expires=2999-12-31] dated
+                jax.jit(f)(x).block_until_ready()
+                return f
+            """,
+        },
+    )
+    assert lint(pkg) == []
+
+
+def test_expired_and_stale_allow_reports_only_suppress003(tmp_path):
+    """An expired record's SUPPRESS003 subsumes the staleness complaint
+    — one actionable finding per comment, not two."""
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/k.py": """
+            def f(x):
+                return x  # crdtlint: allow[donation expires=2000-01-01] old
+            """,
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"SUPPRESS003"}
+    assert len(found) == 1
+
+
+def test_malformed_expiry_date_fails_closed(tmp_path):
+    """A typo'd date (month 13) counts as expired — a guard that can
+    never expire because of a typo must surface, not silently live
+    forever."""
+    pkg = make_pkg(
+        tmp_path,
+        {
+            "ops/k.py": """
+            import jax
+
+            def probe(f, x):
+                # crdtlint: allow[host-sync expires=2026-13-01] typo
+                jax.jit(f)(x).block_until_ready()
+                return f
+            """,
+        },
+    )
+    found = lint(pkg)
+    assert rules_of(found) == {"SUPPRESS003"}
+    assert "2026-13-01" in found[0].message

@@ -1,7 +1,7 @@
 """The crdtlint tier-1 gate.
 
 One test runs the FULL rule suite (all families: LOCK, RACE, SYNC,
-PURE, DONATE, WIRE, WAL, OBS, SHAPE, LEAK, SPMD, TRANSFER + the
+PURE, DONATE, WIRE, WAL, OBS, SHAPE, LEAK, SPMD, TRANSFER, FAULT + the
 SUPPRESS hygiene pass) over the real package
 through the engine and fails on any non-baselined finding — this is the
 regression gate CI leans on, so it renders findings verbatim on
@@ -52,11 +52,13 @@ def test_gate_covers_every_catalogued_family():
                    "DONATE001", "WIRE001", "WIRE005", "WAL001", "WAL002",
                    "OBS001", "OBS002", "SHAPE001", "SHAPE002", "LEAK001",
                    "SPMD001", "TRANSFER001", "TRANSFER002",
-                   "SUPPRESS001", "SUPPRESS002"):
+                   "FAULT001", "FAULT002", "FAULT003", "FAULT004",
+                   "FAULT005",
+                   "SUPPRESS001", "SUPPRESS002", "SUPPRESS003"):
         assert family in catalogued
     # every registered checker's module exports at least one catalogued
     # rule id (wiring smoke, not a bijection)
-    assert len(ALL_RULES) >= 13
+    assert len(ALL_RULES) >= 14
 
 
 def test_full_suite_wall_clock_budget():
@@ -115,6 +117,38 @@ def test_jobs_parallel_matches_serial_on_transfer_red_tree():
     parallel = run_lint([REPO_ROOT / PKG], overlay=overlay, jobs=3)
     assert serial == parallel
     assert any(f.rule == "TRANSFER001" for f in serial[0])
+
+
+def test_jobs_parallel_matches_serial_on_fault_red_tree():
+    """FAULT parity leg (ISSUE 20): the fault checker mixes a
+    whole-project pass (FAULT005 dedupes faultpoint labels and checks
+    the SITES vocabulary package-wide) with per-module walks — the
+    per-rule sharding must keep a firing FAULT tree byte-identical
+    serial vs parallel."""
+    rel = f"{PKG}/utils/faults.py"
+    src = (REPO_ROOT / rel).read_text()
+    anchor = '    "fleet.loop",'
+    assert anchor in src
+    overlay = {rel: src.replace(anchor, anchor + '\n    "ghost.site",', 1)}
+    serial = run_lint([REPO_ROOT / PKG], overlay=overlay)
+    parallel = run_lint([REPO_ROOT / PKG], overlay=overlay, jobs=3)
+    assert serial == parallel
+    assert any(f.rule == "FAULT005" for f in serial[0])
+
+
+def test_fault_family_pinned_at_zero_findings_empty_baseline():
+    """The FAULT family gates the real tree at ZERO findings with an
+    EMPTY baseline — the failure-atomicity instrument starts clean, so
+    any future torn window / swallowed exception / ordering slip is a
+    red gate, not a new baseline entry."""
+    baseline = load_baseline(DEFAULT_BASELINE)
+    assert not [e for e in baseline if e[1].startswith("FAULT")]
+    new, baselined, _allowed = run_lint(
+        [REPO_ROOT / PKG],
+        select={"FAULT001", "FAULT002", "FAULT003", "FAULT004", "FAULT005"},
+    )
+    assert [f for f in new if f.rule.startswith("FAULT")] == []
+    assert baselined == []
 
 
 def test_transfer_family_pinned_at_zero_findings_empty_baseline():
@@ -192,8 +226,55 @@ def test_cli_list_rules_names_all_families():
     for rule in ("LOCK002", "LOCK003", "RACE001", "RACE005", "WIRE001",
                  "WIRE004", "WIRE005", "WAL001", "WAL002", "SHAPE001",
                  "SHAPE002", "LEAK001", "SPMD001", "TRANSFER001",
-                 "TRANSFER002", "SUPPRESS001"):
+                 "TRANSFER002", "FAULT001", "FAULT003", "FAULT005",
+                 "SUPPRESS001", "SUPPRESS003"):
         assert rule in out
+
+
+def test_cli_sarif_format(tmp_path):
+    """--format sarif emits one valid SARIF 2.1.0 document on stdout:
+    rule metadata from the catalog, one result per finding keyed by
+    ruleIndex, 1-based regions — the code-scanning upload contract."""
+    import json
+
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "box.py").write_text(
+        "import threading\n\n\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._items = []\n\n"
+        "    def put(self, x):\n"
+        "        with self._lock:\n"
+        "            self._items.append(x)\n\n"
+        "    def size(self):\n"
+        "        return len(self._items)\n"
+    )
+    proc = _cli(str(pkg), "--format", "sarif", "--no-baseline")
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)  # stdout is ONLY the document
+    assert doc["version"] == "2.1.0"
+    driver = doc["runs"][0]["tool"]["driver"]
+    assert driver["name"] == "crdtlint"
+    rules = driver["rules"]
+    assert {r["id"] for r in rules} == {rule for rule, _ in RULE_CATALOG}
+    results = doc["runs"][0]["results"]
+    assert results, "red fixture tree must produce results"
+    for res in results:
+        assert rules[res["ruleIndex"]]["id"] == res["ruleId"]
+        assert res["level"] == "error"
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith(".py")
+        assert loc["region"]["startLine"] >= 1
+    assert any(res["ruleId"] == "LOCK001" for res in results)
+
+    # green tree → exit 0, still a parseable document with zero results
+    proc = _cli(PKG, "--format", "sarif")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["runs"][0]["results"] == []
 
 
 def test_cli_jobs_and_stats():

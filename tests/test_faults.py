@@ -1,0 +1,327 @@
+"""Deterministic fault-point injection (crdtlint v6, FAULT family runtime).
+
+Three layers under test:
+
+1. the registry/plan mechanics (``utils/faults.py``): seeded schedules
+   replay identically, rules fire exactly once at the Nth hit of their
+   site, ``suspended()`` pauses without consuming hits, and the
+   disarmed path is behaviourally inert;
+2. the runtime wiring: an injected failure at a commit boundary rolls
+   the replica's seq back and stages nothing durable (retry-safe), the
+   WAL scrubs a failed group commit (no duplicate-seq logs), and a
+   ``partial_write`` mints a torn tail that recovery truncates to the
+   durable prefix;
+3. the black box (ISSUE 20 satellite): flight-ring overflow keeps the
+   NEWEST events, and ``Replica.crash()`` dumps the ring to
+   ``flight_dump_path`` even when a log sink raises mid-dump.
+"""
+
+import json
+import os
+
+import pytest
+
+from delta_crdt_ex_tpu import AWLWWMap
+from delta_crdt_ex_tpu.api import start_link
+from delta_crdt_ex_tpu.runtime import telemetry
+from delta_crdt_ex_tpu.runtime.metrics import FlightRecorder
+from delta_crdt_ex_tpu.utils import faults
+from delta_crdt_ex_tpu.utils.faults import (
+    ACTIONS,
+    SITES,
+    CrashInjected,
+    FaultInjected,
+    FaultPlan,
+    FaultRule,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Every test starts and ends with no armed plan (module-global)."""
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+# ---------------------------------------------------------------------------
+# plan mechanics
+
+
+def test_rule_validation_rejects_unknown_site_action_and_bad_nth():
+    with pytest.raises(ValueError):
+        FaultRule("no.such.site", 1, "raise")
+    with pytest.raises(ValueError):
+        FaultRule("wal.append", 1, "explode")
+    with pytest.raises(ValueError):
+        FaultRule("wal.append", 0, "raise")
+
+
+def test_seeded_plans_replay_identically():
+    a = FaultPlan.seeded(42, n_rules=5)
+    b = FaultPlan.seeded(42, n_rules=5)
+    assert [(r.site, r.nth, r.action) for r in a.rules] == [
+        (r.site, r.nth, r.action) for r in b.rules
+    ]
+    c = FaultPlan.seeded(43, n_rules=5)
+    assert [(r.site, r.nth, r.action) for r in a.rules] != [
+        (r.site, r.nth, r.action) for r in c.rules
+    ]
+    for r in a.rules:
+        assert r.site in SITES and r.action in ACTIONS
+
+
+def test_disarmed_faultpoint_is_inert():
+    assert faults.active() is None
+    for _ in range(100):
+        assert faults.faultpoint("wal.append") is None
+    # nothing counted, nothing tripped
+    plan = faults.arm(FaultPlan([("wal.append", 1, "raise")]))
+    assert plan.hits == {}
+
+
+def test_rule_fires_exactly_once_at_nth_hit():
+    with faults.armed(FaultPlan([("wal.append", 3, "raise")])) as plan:
+        assert faults.faultpoint("wal.append") is None
+        assert faults.faultpoint("wal.append") is None
+        with pytest.raises(FaultInjected):
+            faults.faultpoint("wal.append")
+        # fired rules stay down: hit 3 does not re-trip on later hits
+        for _ in range(5):
+            assert faults.faultpoint("wal.append") is None
+        assert plan.exhausted()
+
+
+def test_unrelated_site_hits_do_not_consume_the_rule():
+    with faults.armed(FaultPlan([("wal.fsync", 1, "raise")])):
+        for _ in range(10):
+            assert faults.faultpoint("wal.append") is None
+        with pytest.raises(FaultInjected):
+            faults.faultpoint("wal.fsync")
+
+
+def test_crash_before_raises_crash_injected():
+    with faults.armed(FaultPlan([("wal.write", 1, "crash_before")])):
+        with pytest.raises(CrashInjected):
+            faults.faultpoint("wal.write")
+
+
+def test_crash_after_trips_at_next_hit_of_any_site():
+    with faults.armed(
+        FaultPlan([("replica.durable", 1, "crash_after")])
+    ) as plan:
+        assert faults.faultpoint("replica.durable") is None  # arms only
+        assert plan.pending_crash == "replica.durable"
+        with pytest.raises(CrashInjected, match="replica.durable"):
+            faults.faultpoint("transport.send")  # ANY next hit trips
+
+
+def test_partial_write_returns_clamped_fraction():
+    with faults.armed(FaultPlan([
+        FaultRule("wal.write", 1, "partial_write", 0.25),
+        FaultRule("wal.write", 2, "partial_write", 7.5),
+    ])):
+        assert faults.faultpoint("wal.write") == 0.25
+        assert faults.faultpoint("wal.write") == 0.99  # clamped
+
+
+def test_rearming_a_plan_resets_its_counters():
+    plan = FaultPlan([("wal.append", 2, "raise")])
+    faults.arm(plan)
+    assert faults.faultpoint("wal.append") is None
+    faults.arm(plan)  # reset: the earlier hit is forgotten
+    assert faults.faultpoint("wal.append") is None
+    with pytest.raises(FaultInjected):
+        faults.faultpoint("wal.append")
+
+
+def test_suspended_pauses_without_consuming_hits():
+    with faults.armed(FaultPlan([("wal.append", 2, "raise")])) as plan:
+        assert faults.faultpoint("wal.append") is None
+        with faults.suspended():
+            # recovery replay: same code paths, no schedule consumption
+            for _ in range(10):
+                assert faults.faultpoint("wal.append") is None
+        assert plan.hits["wal.append"] == 1  # untouched by the replay
+        with pytest.raises(FaultInjected):
+            faults.faultpoint("wal.append")
+
+
+def test_trips_ledger_and_telemetry_emission():
+    before = faults.trips().get("wal.rotate", 0)
+    seen = []
+    handler = lambda ev, meas, meta: seen.append((meas, meta))
+    telemetry.attach(telemetry.FAULT_TRIP, handler)
+    try:
+        with faults.armed(FaultPlan([("wal.rotate", 1, "raise")])):
+            with pytest.raises(FaultInjected):
+                faults.faultpoint("wal.rotate")
+    finally:
+        telemetry.detach(telemetry.FAULT_TRIP, handler)
+    assert faults.trips()["wal.rotate"] == before + 1
+    assert seen == [({"trips": 1}, {"site": "wal.rotate"})]
+    v = faults.varz()
+    assert v["kind"] == "faults" and v["armed"] is False
+
+
+# ---------------------------------------------------------------------------
+# runtime wiring: commit boundaries, WAL scrub, torn tails
+
+
+def _spawn(name, wal_dir, **kw):
+    return start_link(
+        AWLWWMap, threaded=False, name=name, capacity=128, tree_depth=5,
+        wal_dir=wal_dir, fsync_mode="batch", **kw,
+    )
+
+
+def test_injected_commit_failure_rolls_seq_back_and_stages_nothing(tmp_path):
+    rep = _spawn("flt_roll", str(tmp_path))
+    try:
+        rep.mutate("add", ["a", 1])
+        seq0 = rep._seq
+        with faults.armed(FaultPlan([("replica.durable", 1, "raise")])):
+            with pytest.raises(FaultInjected):
+                rep.mutate("add", ["b", 2])
+        assert rep._seq == seq0, "failed commit must roll the seq back"
+        rep.mutate("add", ["b", 2])  # retry commits cleanly
+        assert rep.read() == {"a": 1, "b": 2}
+    finally:
+        rep.crash()
+    rec = _spawn("flt_roll", str(tmp_path))
+    try:
+        # recovery replays a contiguous log: the failed attempt left no
+        # record, the retry's record replays at the rolled-back seq
+        assert rec.read() == {"a": 1, "b": 2}
+    finally:
+        rec.crash()
+
+
+def test_fsync_failure_scrubs_batch_so_retry_cannot_duplicate_seq(tmp_path):
+    """Regression: a fault between WAL byte-write and fsync used to
+    leave the record durable while the caller rolled its seq back — the
+    retry then minted the same seq and recovery (correctly) rejected
+    the duplicate-seq log as corrupt."""
+    rep = _spawn("flt_scrub", str(tmp_path))
+    try:
+        rep.mutate("add", ["a", 1])
+        with faults.armed(FaultPlan([("wal.fsync", 1, "raise")])):
+            with pytest.raises(FaultInjected):
+                rep.mutate("add", ["b", 2])
+        rep.mutate("add", ["b", 2])  # same seq re-minted — must be unique
+    finally:
+        rep.crash()
+    rec = _spawn("flt_scrub", str(tmp_path))
+    try:
+        assert rec.read() == {"a": 1, "b": 2}
+    finally:
+        rec.crash()
+
+
+def test_aborted_commit_drops_staged_record_from_the_buffer(tmp_path):
+    """Regression (found by ``bench.py --chaos`` seed 14): crash_after
+    armed at ``wal.append`` trips at ``wal.write`` — after the record
+    is staged but before it is written. If the stale staged bytes
+    survive in the append buffer, the replica's next successful commit
+    flushes them alongside the retry's re-minted seq."""
+    rep = _spawn("flt_abort", str(tmp_path))
+    try:
+        rep.mutate("add", ["a", 1])
+        with faults.armed(FaultPlan([("wal.append", 1, "crash_after")])):
+            with pytest.raises(CrashInjected):
+                rep.mutate("add", ["b", 2])
+        # the "process" survived in-test: the very next commit must not
+        # resurrect the aborted record
+        rep.mutate("add", ["c", 3])
+    finally:
+        rep.crash()
+    rec = _spawn("flt_abort", str(tmp_path))
+    try:
+        assert rec.read() == {"a": 1, "c": 3}
+    finally:
+        rec.crash()
+
+
+def test_partial_write_tears_tail_and_recovery_truncates(tmp_path):
+    rep = _spawn("flt_torn", str(tmp_path))
+    try:
+        with faults.armed(FaultPlan([
+            FaultRule("wal.write", 3, "partial_write", 0.5),
+        ])):
+            rep.mutate("add", ["a", 1])
+            rep.mutate("add", ["b", 2])
+            with pytest.raises(CrashInjected, match="partial WAL write"):
+                rep.mutate("add", ["c", 3])
+    finally:
+        rep.crash()
+    rec = _spawn("flt_torn", str(tmp_path))
+    try:
+        # the torn record was never published (FAULT003 ordering), so
+        # truncating it loses nothing acknowledged
+        assert rec.read() == {"a": 1, "b": 2}
+    finally:
+        rec.crash()
+
+
+# ---------------------------------------------------------------------------
+# the black box: flight-ring overflow + crash dumps
+
+
+def test_flight_ring_overflow_keeps_newest_events():
+    fr = FlightRecorder("ringtest", capacity=8)
+    for i in range(20):
+        fr.record("tick", i=i)
+    evs = fr.events()
+    assert len(evs) == 8
+    assert [e["i"] for e in evs] == list(range(12, 20))
+    assert fr.dropped() == 12
+    assert fr.events_recorded() == 20
+
+
+def test_flight_dump_survives_a_raising_log_sink():
+    fr = FlightRecorder("poisondump", capacity=8)
+    for i in range(5):
+        fr.record("tick", i=i)
+
+    class FlakyLog:
+        def __init__(self):
+            self.lines = 0
+
+        def error(self, *a, **kw):
+            self.lines += 1
+            if self.lines % 2 == 0:
+                raise RuntimeError("sink died")
+
+    flaky = FlakyLog()
+    assert fr.dump(log=flaky) == 5  # every event attempted, none lost
+
+
+def test_crash_dumps_flight_ring_to_file_under_injected_fault(tmp_path):
+    dump = tmp_path / "blackbox.jsonl"
+    rep = start_link(
+        AWLWWMap, threaded=False, name="flt_dump", capacity=128,
+        tree_depth=5, wal_dir=str(tmp_path / "w"), fsync_mode="batch",
+        obs=True, flight_dump_path=str(dump),
+    )
+    rep.mutate("add", ["a", 1])
+    with faults.armed(FaultPlan([("replica.durable", 1, "crash_before")])):
+        with pytest.raises(CrashInjected):
+            rep.mutate("add", ["b", 2])
+    rep.crash()
+    assert dump.exists(), "crash() must write the black box"
+    lines = [json.loads(l) for l in dump.read_text().splitlines()]
+    assert lines, "dump file must hold the ring events"
+    assert all(e["replica"] == "flt_dump" for e in lines)
+    # the injected failure itself is in the black box: the failed
+    # commit recorded a commit_abort trace before re-raising
+    aborts = [e for e in lines if e["kind"] == "commit_abort"]
+    assert aborts and "CrashInjected" in aborts[0]["error"]
+    # a second crash of a fresh incarnation APPENDS (history preserved)
+    n0 = len(lines)
+    rec = start_link(
+        AWLWWMap, threaded=False, name="flt_dump", capacity=128,
+        tree_depth=5, wal_dir=str(tmp_path / "w"), fsync_mode="batch",
+        obs=True, flight_dump_path=str(dump),
+    )
+    rec.crash()
+    assert len(dump.read_text().splitlines()) >= n0

@@ -99,10 +99,30 @@ RULE_CATALOG = [
     ("TRANSFER002", "transfer-ledger site hygiene: non-literal site label, "
                     "duplicate label (counts would merge), or ghost label "
                     "(registered but never used)"),
+    ("FAULT001", "torn-invariant window: commit-group writes (_seq/"
+                 "_serve_pub/_outstanding/_ack_seq) with a raise-capable "
+                 "durability/fault-point call interposed and no try/finally "
+                 "restoring the group"),
+    ("FAULT002", "bare/broad except in a hot module that neither re-raises, "
+                 "logs, flight-records, nor reads the bound exception — "
+                 "injected faults vanish into a wedged replica"),
+    ("FAULT003", "commit-ordering violation: state published (_publish_serve/"
+                 "_note_state_changed/_emit_diffs/_serve_pub store) before "
+                 "the unit's WAL append — a crash in between loses work "
+                 "readers already observed"),
+    ("FAULT004", "terminal method (stop/close/crash/shutdown) that never "
+                 "reaches a constructed resource's cleanup (Thread.join, "
+                 "WalLog/socket close) — leaks on that path"),
+    ("FAULT005", "fault-point label hygiene: non-literal faultpoint label, "
+                 "label outside the SITES vocabulary, one label at two call "
+                 "sites, or a SITES entry no call site uses"),
     ("SUPPRESS001", "stale allow[...] comment matching no finding (hygiene; "
                     "not itself suppressible)"),
     ("SUPPRESS002", "stale baseline entry matching no finding (hygiene; "
                     "not itself suppressible)"),
+    ("SUPPRESS003", "expired allow[RULE expires=YYYY-MM-DD] comment — "
+                    "re-justify with a new date or fix the finding "
+                    "(hygiene; not itself suppressible)"),
 ]
 
 
@@ -150,9 +170,10 @@ def _main(argv: list[str] | None = None) -> int:
         "--list-rules", action="store_true", help="print the rule catalog"
     )
     parser.add_argument(
-        "--format", choices=("text", "github"), default="text",
-        help="finding output format: plain text (default) or GitHub "
-        "Actions ::error annotations for CI logs",
+        "--format", choices=("text", "github", "sarif"), default="text",
+        help="finding output format: plain text (default), GitHub "
+        "Actions ::error annotations for CI logs, or a SARIF 2.1.0 "
+        "document on stdout for code-scanning upload",
     )
     parser.add_argument(
         "--manifest", type=Path, default=None,
@@ -256,6 +277,17 @@ def _main(argv: list[str] | None = None) -> int:
         )
         return 0
 
+    if args.format == "sarif":
+        # one machine-readable document on stdout, nothing else: the
+        # consumer is a code-scanning uploader, not a human
+        print(_sarif_report(new))
+        if args.stats or not args.quiet:
+            print(
+                f"crdtlint: {len(new)} finding(s) "
+                f"({len(allowed)} allowed inline, {len(baselined)} baselined)",
+                file=sys.stderr,
+            )
+        return 1 if new else 0
     for f in new:
         if args.format == "github":
             # GitHub Actions workflow-command annotation: renders the
@@ -277,6 +309,59 @@ def _main(argv: list[str] | None = None) -> int:
             f"({len(allowed)} allowed inline, {len(baselined)} baselined)"
         )
     return 1 if new else 0
+
+
+def _sarif_report(findings: list[Finding]) -> str:
+    """SARIF 2.1.0 document for code-scanning UIs: rule metadata comes
+    from the catalog (the single source the gate, --list-rules, and
+    --select validate against), each finding one ``result`` keyed by
+    ``ruleIndex`` into it."""
+    import json
+
+    rule_index = {rule: i for i, (rule, _desc) in enumerate(RULE_CATALOG)}
+    rules = [
+        {"id": rule, "shortDescription": {"text": desc}}
+        for rule, desc in RULE_CATALOG
+    ]
+    results = [
+        {
+            "ruleId": f.rule,
+            # findings can only carry catalogued rule ids (the select
+            # validation enforces the same closed set)
+            "ruleIndex": rule_index[f.rule],
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        # SUPPRESS002 baseline entries carry line 0;
+                        # SARIF regions are 1-based
+                        "region": {"startLine": max(f.line, 1)},
+                    }
+                }
+            ],
+        }
+        for f in findings
+    ]
+    doc = {
+        "version": "2.1.0",
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "crdtlint",
+                        "informationUri":
+                            "https://example.invalid/tools/crdtlint",
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2)
 
 
 def _write_protocol_manifest(package_dirs: list[Path], manifest: Path | None) -> int:

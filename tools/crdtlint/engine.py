@@ -10,15 +10,21 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import datetime
 import json
 import re
 from pathlib import Path
 from typing import Iterable
 
 #: allow-comment grammar: ``# crdtlint: allow[tag,tag2] optional why``.
+#: A tag may carry an expiry — ``allow[LOCK003 expires=2026-12-31]`` —
+#: after which SUPPRESS003 flags the comment for re-justification.
 #: The justification text after the bracket is free-form but encouraged
 #: (ARCHITECTURE.md documents the convention: every allow states a why).
-_ALLOW_RE = re.compile(r"#\s*crdtlint:\s*allow\[([A-Za-z0-9_\-, ]+)\]")
+_ALLOW_RE = re.compile(r"#\s*crdtlint:\s*allow\[([A-Za-z0-9_\-, =]+)\]")
+
+#: one comma-separated allow tag, with its optional expiry date
+_TAG_RE = re.compile(r"^([A-Za-z0-9_\-]+)(?:\s+expires=(\d{4}-\d{2}-\d{2}))?$")
 
 #: rule-family tag -> rule-id prefix (an exact rule id or ``all`` also work)
 FAMILY_TAGS = {
@@ -34,13 +40,15 @@ FAMILY_TAGS = {
     "leak": "LEAK",
     "spmd": "SPMD",
     "transfer": "TRANSFER",
+    "fault": "FAULT",
 }
 
-#: hygiene meta-rules (stale suppressions). They report on the
+#: hygiene meta-rules (stale/expired suppressions). They report on the
 #: suppression machinery itself, so they are deliberately NOT
 #: suppressible by allow comments or the baseline — the fix is always
-#: to delete the stale allow/entry (or regenerate the baseline).
-SUPPRESS_RULES = ("SUPPRESS001", "SUPPRESS002")
+#: to delete the stale allow/entry (or regenerate the baseline), or to
+#: re-justify and re-date an expired one (SUPPRESS003).
+SUPPRESS_RULES = ("SUPPRESS001", "SUPPRESS002", "SUPPRESS003")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,12 +72,31 @@ class Finding:
 class AllowRecord:
     """One ``allow[...]`` comment: where it sits, which source lines it
     covers, and whether any finding actually used it this run (the
-    stale-suppression hygiene check, SUPPRESS001)."""
+    stale-suppression hygiene check, SUPPRESS001). ``expiries`` maps a
+    tag to its ``expires=YYYY-MM-DD`` date string (tags without one are
+    perpetual); an expired tag still suppresses — the gate goes red
+    through ONE actionable SUPPRESS003 at the comment, not through the
+    original finding popping back up at an unrelated line."""
 
     comment_line: int
     lines: frozenset
     tags: frozenset
+    expiries: dict = dataclasses.field(default_factory=dict)
     used: bool = False
+
+    def expired_tags(self, today: "datetime.date") -> list[tuple[str, str]]:
+        """``(tag, date_str)`` for every tag whose expiry has passed (an
+        unparseable date — e.g. month 13 — counts as expired: a typo'd
+        guard must fail closed, not silently never expire)."""
+        out: list[tuple[str, str]] = []
+        for tag, date_str in sorted(self.expiries.items()):
+            try:
+                expired = datetime.date.fromisoformat(date_str) < today
+            except ValueError:
+                expired = True
+            if expired:
+                out.append((tag, date_str))
+        return out
 
 
 class ModuleInfo:
@@ -97,7 +124,22 @@ class ModuleInfo:
             m = _ALLOW_RE.search(raw)
             if not m:
                 continue
-            tags = frozenset(t.strip() for t in m.group(1).split(",") if t.strip())
+            tags: set[str] = set()
+            expiries: dict[str, str] = {}
+            for chunk in m.group(1).split(","):
+                chunk = chunk.strip()
+                if not chunk:
+                    continue
+                tm = _TAG_RE.match(chunk)
+                if tm is None:
+                    # malformed tag spec (e.g. a bad expires= shape):
+                    # keep the raw text as a tag so it matches nothing
+                    # and SUPPRESS001 surfaces the typo
+                    tags.add(chunk)
+                    continue
+                tags.add(tm.group(1))
+                if tm.group(2) is not None:
+                    expiries[tm.group(1)] = tm.group(2)
             covered = {i}
             if raw.lstrip().startswith("#"):
                 # a pure-comment allow annotates the next SOURCE line:
@@ -107,7 +149,9 @@ class ModuleInfo:
                 while j < len(lines) and lines[j].lstrip().startswith("#"):
                     j += 1
                 covered.add(j + 1)
-            records.append(AllowRecord(i, frozenset(covered), tags))
+            records.append(
+                AllowRecord(i, frozenset(covered), frozenset(tags), expiries)
+            )
         return records
 
     def match_allow(self, line: int, rule: str) -> AllowRecord | None:
@@ -457,9 +501,22 @@ def run_lint(
             else:
                 new.append(f)
         if hygiene:
+            today = datetime.date.today()
             for mod in project.modules.values():
                 for rec in mod.allow_records:
-                    if not rec.used:
+                    expired = rec.expired_tags(today)
+                    for tag, date_str in expired:
+                        new.append(Finding(
+                            mod.rel, rec.comment_line, "SUPPRESS003",
+                            f"expired suppression: allow[{tag} "
+                            f"expires={date_str}] is past its expiry — "
+                            f"re-justify with a new date, or fix the "
+                            f"underlying finding and delete the comment",
+                        ))
+                    if not rec.used and not expired:
+                        # an expired record's SUPPRESS003 subsumes the
+                        # staleness complaint — one actionable finding
+                        # per comment, not two
                         tags = ",".join(sorted(rec.tags))
                         new.append(Finding(
                             mod.rel, rec.comment_line, "SUPPRESS001",

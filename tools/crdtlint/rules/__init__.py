@@ -103,6 +103,7 @@ from tools.crdtlint.rules.shapes import check_shapes
 from tools.crdtlint.rules.leaks import check_leaks
 from tools.crdtlint.rules.spmd import check_spmd
 from tools.crdtlint.rules.transfers import check_transfers
+from tools.crdtlint.rules.faults import check_faults
 
 ALL_RULES = [
     check_lock_discipline,
@@ -118,4 +119,5 @@ ALL_RULES = [
     check_leaks,
     check_spmd,
     check_transfers,
+    check_faults,
 ]

@@ -1,0 +1,584 @@
+"""FAULT001–005 — failure-atomicity audit over the runtime's hot path.
+
+The anti-entropy algorithm survives crash/recovery only if the failure
+paths keep the seq/WAL/state invariants — and with a deterministic
+fault-injection runtime (``utils/faults.py``) now able to raise at any
+labelled boundary, "an exception here is impossible" stops being an
+excuse anywhere a fault point is reachable. This family makes the
+failure discipline static:
+
+- **FAULT001** — torn-invariant window: a unit writes one of the
+  commit-group attributes (``_seq``, ``_serve_pub``, ``_outstanding``,
+  ``_ack_seq``), then performs a raise-capable durability/fault-point
+  call, then writes a commit-group attribute again — with no enclosing
+  ``try`` whose handler/finally restores a group attribute. An
+  injected raise mid-window leaves the group half-advanced (a seq that
+  names a record that never landed). A loop whose body contains both a
+  group write and an unprotected raise-capable call is the same window
+  wrapped around the back edge.
+- **FAULT002** — swallowed exception: a bare/broad ``except`` in a hot
+  module that neither re-raises, nor logs, nor records to the flight
+  recorder, nor even reads the bound exception. Under fault injection
+  a silent swallow converts a scheduled failure into a wedged replica
+  with an empty black box.
+- **FAULT003** — commit-ordering: in any unit with both a durability
+  event (``self._durable``/``self._durable_batch``/``self._wal.append``)
+  and a state-publication event (``self._publish_serve`` /
+  ``self._note_state_changed`` / ``self._emit_diffs`` / a store to
+  ``self._serve_pub``), no publication may precede the unit's first
+  durability event in statement order. A crash between a publication
+  and its append loses work readers already observed — the
+  undocumented direction; the documented one (durable but
+  unpublished) replays idempotently on recovery.
+- **FAULT004** — cleanup-on-all-paths: a hot-module class constructing
+  a joinable/closable resource (``Thread`` → ``join``, ``WalLog`` /
+  sockets → ``close``) must reach that cleanup from EVERY terminal
+  method it defines (``stop``/``close``/``crash``/``shutdown``), via
+  self-calls if need be — a crash path that skips the WAL close leaks
+  the fd and, worse, skips the crash-model contract.
+- **FAULT005** — fault-point label hygiene (the TRANSFER002 shape over
+  ``utils/faults.py``): a non-literal ``faultpoint(...)`` label, a
+  label outside the closed ``SITES`` vocabulary, one label used from
+  two call sites (a chaos schedule must pin ONE program point), and a
+  ``SITES`` entry with no call site (ghost vocabulary).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.crdtlint.engine import Finding, ModuleInfo, Project, _dotted
+from tools.crdtlint.rules import call_leaf, outer_function_defs, self_attr
+from tools.crdtlint.rules.threadgraph import ClassAnalysis
+
+RULE_TORN = "FAULT001"
+RULE_SWALLOW = "FAULT002"
+RULE_ORDER = "FAULT003"
+RULE_CLEANUP = "FAULT004"
+RULE_LABELS = "FAULT005"
+
+#: the failure-path hot modules: the replica/fleet commit planes, the
+#: serving front door, the relay tier, the TCP transport, and the WAL
+_HOT_LEAVES = {"replica", "fleet", "serve", "treesync", "tcp_transport", "wal"}
+
+#: attributes forming the replica's commit invariant group: a fault
+#: between two writes of these tears the seq/WAL/ack/publication story
+_COMMIT_ATTRS = {"_seq", "_serve_pub", "_outstanding", "_ack_seq"}
+
+#: ``self._wal.<leaf>`` calls that may raise (I/O) mid-commit
+_WAL_RAISING = {"append", "commit", "rotate", "maybe_sync"}
+
+#: self-call leaves that are raise-capable durability points
+_DURABLE_LEAVES = {"_durable", "_durable_batch"}
+
+#: publication call leaves — the moment other threads may observe state
+_PUBLISH_LEAVES = {"_publish_serve", "_note_state_changed", "_emit_diffs"}
+
+#: resource constructor leaf -> accepted cleanup call leaves (FAULT004)
+_RESOURCE_CLEANUP = {
+    "Thread": ("join",),
+    "WalLog": ("close",),
+    "socket": ("close",),
+}
+
+#: terminal methods: every one a class defines must reach each cleanup
+_TERMINAL_METHODS = ("close", "crash", "shutdown", "stop")
+
+
+def _is_hot(mod_name: str) -> bool:
+    return mod_name.rsplit(".", 1)[-1] in _HOT_LEAVES
+
+
+# ----------------------------------------------------------------------
+# FAULT001 — torn-invariant windows
+
+
+def _commit_attr_write(stmt: ast.stmt) -> str | None:
+    """Statement writing a commit-group attr: ``self._seq += 1``,
+    ``self._seq = x``, ``self._outstanding[k] = v`` / ``del`` forms."""
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = (
+            stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        )
+        for t in targets:
+            base = t.value if isinstance(t, ast.Subscript) else t
+            attr = self_attr(base)
+            if attr in _COMMIT_ATTRS:
+                return attr
+    if isinstance(stmt, ast.Delete):
+        for t in stmt.targets:
+            base = t.value if isinstance(t, ast.Subscript) else t
+            attr = self_attr(base)
+            if attr in _COMMIT_ATTRS:
+                return attr
+    return None
+
+
+def _is_raise_capable(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id == "faultpoint"
+    if isinstance(f, ast.Attribute):
+        if f.attr == "faultpoint":
+            return True
+        if f.attr in _DURABLE_LEAVES and self_attr(f) is not None:
+            return True
+        if f.attr in _WAL_RAISING and _dotted(f.value) == "self._wal":
+            return True
+    return False
+
+
+def _restorer_methods(tree: ast.AST) -> set[str]:
+    """Method names whose body writes a commit-group attr — one
+    interprocedural step: a handler calling ``self._commit_abort(...)``
+    restores the group exactly as an inline ``self._seq -= 1`` does,
+    and factoring the rollback into a helper must not read as torn."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.stmt) and _commit_attr_write(inner):
+                    out.add(node.name)
+                    break
+    return out
+
+
+def _restores_group(stmts: list[ast.stmt], restorers: set[str]) -> bool:
+    """Does this handler/finally suite restore the commit group —
+    directly (attr write) or via a same-class restorer method?"""
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, ast.stmt) and _commit_attr_write(node):
+                return True
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and self_attr(node.func) in restorers
+            ):
+                return True
+    return False
+
+
+def _try_protects(node: ast.Try, restorers: set[str]) -> bool:
+    if _restores_group(node.finalbody, restorers):
+        return True
+    return any(_restores_group(h.body, restorers) for h in node.handlers)
+
+
+class _TornScan:
+    """Ordered event walk of one unit body: ``("w", attr, line)`` for
+    commit-group writes, ``("c", line)`` for raise-capable calls NOT
+    under a restoring ``try``. Loop bodies are summarised separately so
+    the back-edge window (write in iteration N+1 after an unprotected
+    call in iteration N) is caught."""
+
+    def __init__(self, restorers: set[str] | None = None) -> None:
+        self.restorers = restorers or set()
+        self.findings_at: list[tuple[int, str]] = []
+
+    def scan_unit(self, fn: ast.FunctionDef) -> None:
+        events = self._suite(fn.body, protected=False)
+        self._straight_line(events)
+
+    def _straight_line(self, events: list[tuple]) -> None:
+        armed_write: str | None = None
+        pending_call: int | None = None
+        for ev in events:
+            if ev[0] == "w":
+                if armed_write is not None and pending_call is not None:
+                    self.findings_at.append((ev[2], armed_write))
+                    pending_call = None
+                armed_write = ev[1]
+            elif ev[0] == "c":
+                if armed_write is not None:
+                    pending_call = ev[1]
+
+    def _suite(self, stmts: list[ast.stmt], protected: bool) -> list[tuple]:
+        events: list[tuple] = []
+        for stmt in stmts:
+            attr = _commit_attr_write(stmt)
+            if attr is not None:
+                events.append(("w", attr, stmt.lineno))
+                # fall through: the value expression may also call
+            if isinstance(stmt, ast.Try):
+                inner = protected or _try_protects(stmt, self.restorers)
+                events.extend(self._suite(stmt.body, inner))
+                for h in stmt.handlers:
+                    events.extend(self._suite(h.body, protected))
+                events.extend(self._suite(stmt.orelse, protected))
+                events.extend(self._suite(stmt.finalbody, protected))
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                body_events = self._suite(stmt.body, protected)
+                # back-edge window: an unprotected raise-capable call
+                # anywhere in the body + any group write in the body
+                calls = [e for e in body_events if e[0] == "c"]
+                writes = [e for e in body_events if e[0] == "w"]
+                if calls and writes:
+                    self.findings_at.append((writes[0][2], writes[0][1]))
+                events.extend(body_events)
+                events.extend(self._suite(stmt.orelse, protected))
+                continue
+            if isinstance(stmt, ast.If):
+                events.extend(self._expr_events(stmt.test, protected))
+                events.extend(self._suite(stmt.body, protected))
+                events.extend(self._suite(stmt.orelse, protected))
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    events.extend(
+                        self._expr_events(item.context_expr, protected)
+                    )
+                events.extend(self._suite(stmt.body, protected))
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # closures run at their call sites; analysed inline,
+                # conservatively with the current protection state
+                events.extend(self._suite(stmt.body, protected))
+                continue
+            events.extend(self._expr_events(stmt, protected))
+        return events
+
+    def _expr_events(self, node: ast.AST, protected: bool) -> list[tuple]:
+        if protected:
+            return []
+        return [
+            ("c", n.lineno)
+            for n in ast.walk(node)
+            if isinstance(n, ast.Call) and _is_raise_capable(n)
+        ]
+
+
+def _torn_findings(mod: ModuleInfo) -> list[Finding]:
+    findings: list[Finding] = []
+    restorers = _restorer_methods(mod.tree)
+    for qual, fn in outer_function_defs(mod.tree):
+        scan = _TornScan(restorers)
+        scan.scan_unit(fn)
+        name = ".".join(qual)
+        seen: set[int] = set()
+        for line, attr in scan.findings_at:
+            if line in seen:
+                continue
+            seen.add(line)
+            findings.append(Finding(
+                mod.rel, line, RULE_TORN,
+                f"torn-invariant window in {mod.name}.{name}: commit-group "
+                f"write (self.{attr}) follows a raise-capable "
+                f"durability/fault-point call with no try/finally restoring "
+                f"the group — an injected raise leaves the commit "
+                f"half-advanced; wrap the call and roll the group back in "
+                f"the handler",
+            ))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# FAULT002 — swallowed exceptions
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+
+    def broad(n: ast.AST) -> bool:
+        return isinstance(n, ast.Name) and n.id in ("Exception", "BaseException")
+
+    if broad(t):
+        return True
+    return isinstance(t, ast.Tuple) and any(broad(e) for e in t.elts)
+
+
+def _handler_records(handler: ast.ExceptHandler) -> bool:
+    """Re-raises, logs, flight-records, or at least reads the bound
+    exception — any of which makes the swallow observable."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            chain = _dotted(node.func) or ""
+            head = chain.split(".", 1)[0]
+            if head in ("logger", "logging", "log"):
+                return True
+            leaf = call_leaf(node)
+            if leaf in ("record", "_flight", "dump"):
+                return True
+            if "flight" in chain:
+                return True
+        if (
+            handler.name is not None
+            and isinstance(node, ast.Name)
+            and node.id == handler.name
+            and isinstance(node.ctx, ast.Load)
+        ):
+            return True
+    return False
+
+
+def _swallow_findings(mod: ModuleInfo) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad_handler(node):
+            continue
+        if _handler_records(node):
+            continue
+        what = "bare except" if node.type is None else "broad except"
+        findings.append(Finding(
+            mod.rel, node.lineno, RULE_SWALLOW,
+            f"{what} in hot module {mod.name} swallows the exception "
+            f"silently — under fault injection this wedges the replica "
+            f"with an empty black box; re-raise, log, or record to the "
+            f"flight recorder",
+        ))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# FAULT003 — commit-ordering (durability happens-before publication)
+
+
+def _order_event(node: ast.AST) -> tuple[str, int] | None:
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _DURABLE_LEAVES and self_attr(f) is not None:
+                return ("dur", node.lineno)
+            if f.attr == "append" and _dotted(f.value) == "self._wal":
+                return ("dur", node.lineno)
+            if f.attr in _PUBLISH_LEAVES and self_attr(f) is not None:
+                return ("pub", node.lineno)
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for t in targets:
+            if self_attr(t) == "_serve_pub":
+                return ("pub", node.lineno)
+    return None
+
+
+def _ordered_events(fn: ast.FunctionDef) -> list[tuple[str, int]]:
+    """Statement-ordered (kind, line) durability/publication events —
+    ``ast.walk`` is breadth-first, so walk child statements in source
+    order instead."""
+    events: list[tuple[str, int]] = []
+
+    def visit(node: ast.AST) -> None:
+        ev = _order_event(node)
+        if ev is not None:
+            events.append(ev)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in fn.body:
+        visit(stmt)
+    return events
+
+
+def _order_findings(mod: ModuleInfo) -> list[Finding]:
+    findings: list[Finding] = []
+    for qual, fn in outer_function_defs(mod.tree):
+        name = ".".join(qual)
+        if "replay" in fn.name:
+            # replay re-publishes already-durable history — the one
+            # commit path where publication without an append is the
+            # contract, not a tear
+            continue
+        events = _ordered_events(fn)
+        durs = [ln for k, ln in events if k == "dur"]
+        if not durs:
+            continue
+        seen_dur = False
+        for kind, line in events:
+            if kind == "dur":
+                seen_dur = True
+            elif not seen_dur:
+                findings.append(Finding(
+                    mod.rel, line, RULE_ORDER,
+                    f"commit-ordering violation in {mod.name}.{name}: state "
+                    f"is published before the unit's WAL append — a crash "
+                    f"in between loses work readers already observed; "
+                    f"append first, publish after",
+                ))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# FAULT004 — cleanup on all terminal paths
+
+
+def _cleanup_findings(mod: ModuleInfo) -> list[Finding]:
+    findings: list[Finding] = []
+    for cls_node in mod.tree.body:
+        if not isinstance(cls_node, ast.ClassDef):
+            continue
+        ca = ClassAnalysis(mod, cls_node)
+        resources: dict[str, tuple[str, ...]] = {}
+        for attr, chain in ca.attr_ctors.items():
+            leaf = chain.rsplit(".", 1)[-1]
+            if leaf in _RESOURCE_CLEANUP:
+                resources[attr] = _RESOURCE_CLEANUP[leaf]
+        if not resources:
+            continue
+        terminals = [m for m in _TERMINAL_METHODS if m in ca.methods]
+        if not terminals:
+            continue
+        from tools.crdtlint.rules.threadgraph import scan_unit
+
+        scans = {
+            name: scan_unit(ca, name, fn) for name, fn in ca.methods.items()
+        }
+        for term in terminals:
+            # attr-calls reachable from the terminal via self-call edges
+            reach: set[str] = set()
+            stack = [term]
+            calls: list = []
+            while stack:
+                u = stack.pop()
+                if u in reach or u not in scans:
+                    continue
+                reach.add(u)
+                calls.extend(scans[u].attr_calls)
+                stack.extend(e.callee for e in scans[u].edges)
+            for attr, cleanups in sorted(resources.items()):
+                if any(
+                    c.attr == attr and c.callee in cleanups for c in calls
+                ):
+                    continue
+                findings.append(Finding(
+                    mod.rel, ca.methods[term].lineno, RULE_CLEANUP,
+                    f"{mod.name}.{ca.name}.{term}() never reaches "
+                    f"self.{attr}.{cleanups[0]}() — the "
+                    f"{ca.attr_ctors[attr].rsplit('.', 1)[-1]} resource "
+                    f"leaks on this terminal path (threads/sockets/WAL "
+                    f"handles must be released on stop() AND crash())",
+                ))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# FAULT005 — fault-point label hygiene
+
+
+def _find_sites_vocabulary(
+    project: Project,
+) -> tuple[ModuleInfo, int, list[str]] | None:
+    """The ``SITES`` tuple in the utils ``faults`` module."""
+    for name in sorted(project.modules):
+        if not name.endswith(".faults") or ".utils." not in f".{name}.":
+            continue
+        mod = project.modules[name]
+        for node in mod.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "SITES"
+                and isinstance(node.value, (ast.Tuple, ast.List))
+            ):
+                labels = [
+                    e.value
+                    for e in node.value.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                ]
+                return mod, node.lineno, labels
+    return None
+
+
+def _is_faultpoint_call(node: ast.Call, mod: ModuleInfo) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name) and f.id == "faultpoint":
+        imp = mod.imports.get("faultpoint")
+        return (
+            imp is not None
+            and imp[0] == "sym"
+            and imp[1].rsplit(".", 1)[-1] == "faults"
+        )
+    if isinstance(f, ast.Attribute) and f.attr == "faultpoint":
+        if isinstance(f.value, ast.Name):
+            imp = mod.imports.get(f.value.id)
+            if imp is not None and imp[0] in ("mod", "modroot"):
+                return imp[1].rsplit(".", 1)[-1] == "faults"
+            return f.value.id == "faults"
+    return False
+
+
+def _label_findings(project: Project) -> list[Finding]:
+    vocab = _find_sites_vocabulary(project)
+    if vocab is None:
+        return []
+    faults_mod, sites_line, labels = vocab
+    findings: list[Finding] = []
+    #: label -> list of (module rel, line) call sites
+    sites: dict[str, list[tuple[str, int]]] = {}
+    for mod_name in sorted(project.modules):
+        mod = project.modules[mod_name]
+        if mod is faults_mod:
+            continue  # the registry's own machinery takes label params
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and _is_faultpoint_call(node, mod)
+            ):
+                continue
+            label_node = node.args[0] if node.args else None
+            if not (
+                isinstance(label_node, ast.Constant)
+                and isinstance(label_node.value, str)
+            ):
+                findings.append(Finding(
+                    mod.rel, node.lineno, RULE_LABELS,
+                    f"faultpoint label in {mod.name} is not a string "
+                    f"literal — chaos schedules key on statically knowable "
+                    f"site names",
+                ))
+                continue
+            label = label_node.value
+            if label not in labels:
+                findings.append(Finding(
+                    mod.rel, node.lineno, RULE_LABELS,
+                    f"faultpoint label {label!r} in {mod.name} is not in "
+                    f"the SITES vocabulary ({faults_mod.rel}) — add it "
+                    f"there (sorted) or fix the typo",
+                ))
+                continue
+            sites.setdefault(label, []).append((mod.rel, node.lineno))
+    for label, where in sorted(sites.items()):
+        if len(where) > 1:
+            first = where[0]
+            for rel, line in where[1:]:
+                findings.append(Finding(
+                    rel, line, RULE_LABELS,
+                    f"faultpoint label {label!r} already used at "
+                    f"{first[0]}:{first[1]} — one label must pin exactly "
+                    f"one program point (a chaos schedule naming it would "
+                    f"trip at whichever site hits first)",
+                ))
+    for label in labels:
+        if label not in sites:
+            findings.append(Finding(
+                faults_mod.rel, sites_line, RULE_LABELS,
+                f"SITES entry {label!r} has no faultpoint call site — "
+                f"ghost vocabulary: chaos schedules targeting it can "
+                f"never trip (delete the entry or add the call site)",
+            ))
+    return findings
+
+
+# ----------------------------------------------------------------------
+
+
+def check_faults(project: Project) -> list[Finding]:
+    findings = _label_findings(project)
+    for mod_name in sorted(project.modules):
+        mod = project.modules[mod_name]
+        if not _is_hot(mod_name):
+            continue
+        findings.extend(_torn_findings(mod))
+        findings.extend(_swallow_findings(mod))
+        findings.extend(_order_findings(mod))
+        findings.extend(_cleanup_findings(mod))
+    return findings
